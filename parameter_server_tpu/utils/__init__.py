@@ -1,0 +1,4 @@
+"""Host-side utilities (reference analog: src/util/)."""
+
+from parameter_server_tpu.utils.hashing import hash_keys, splitmix64  # noqa: F401
+from parameter_server_tpu.utils.keyrange import KeyRange  # noqa: F401

@@ -1,0 +1,132 @@
+"""Typed configuration (reference analog: gflags + protobuf text-format configs).
+
+The reference splits config in two tiers (ref: src/main.cc gflags for
+topology; src/app/linear_method/proto/linear_method.proto for the app).
+Here the same inventory of fields lives in dataclasses, loadable from
+JSON or TOML. Field names are kept close to the reference's proto fields
+(``minibatch``, ``max_delay``, ``lambda_l1`` ...) so parity is auditable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+
+@dataclass
+class DataConfig:
+    """Ref: linear_method.proto DataConfig {format, file, ignore_feature_group}."""
+
+    files: list[str] = field(default_factory=list)
+    format: str = "libsvm"  # libsvm | criteo | cache
+    num_keys: int = 1 << 22  # dense hashed key-space size (power of two + pad row)
+    val_files: list[str] = field(default_factory=list)
+    max_nnz_per_example: int = 512
+    cache_dir: str = ""  # columnar block cache (ref: SlotReader cache)
+
+
+@dataclass
+class LearningRateConfig:
+    """Ref: learning_rate.h — alpha/beta as in the FTRL paper."""
+
+    alpha: float = 0.1
+    beta: float = 1.0
+    eta: float = 0.1  # plain SGD step size
+    decay: float = 0.0
+
+
+@dataclass
+class PenaltyConfig:
+    """Ref: penalty.h — elastic net."""
+
+    lambda_l1: float = 1.0
+    lambda_l2: float = 0.0
+
+
+@dataclass
+class SolverConfig:
+    """Ref: linear_method.proto solver settings (sgd/ftrl/darlin)."""
+
+    algo: str = "ftrl"  # ftrl | adagrad | sgd | darlin
+    minibatch: int = 4096
+    max_delay: int = 0  # SSP bounded delay tau; 0 => BSP, <0 => fully async
+    epochs: int = 1
+    # darlin-only:
+    block_iters: int = 20
+    feature_blocks: int = 16
+    kkt_filter_threshold: float = 0.0  # 0 disables the KKT filter
+    epsilon: float = 1e-4  # relative-objective stopping rule
+
+
+@dataclass
+class ParallelConfig:
+    """Mesh topology: the TPU analog of -num_servers / -num_workers."""
+
+    kv_shards: int = 1  # 'kv' mesh axis: range-sharded state (servers)
+    data_shards: int = 1  # 'data' mesh axis: example shards (workers)
+
+
+@dataclass
+class PSConfig:
+    """Top-level app config (ref: linear_method.proto LinearMethodConfig)."""
+
+    app: str = "linear_method"
+    data: DataConfig = field(default_factory=DataConfig)
+    lr: LearningRateConfig = field(default_factory=LearningRateConfig)
+    penalty: PenaltyConfig = field(default_factory=PenaltyConfig)
+    solver: SolverConfig = field(default_factory=SolverConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    model_output: str = ""
+    report_interval: int = 1  # progress print cadence, in reports (ref gflag)
+    seed: int = 0
+
+
+def _from_dict(cls: type, d: dict[str, Any]) -> Any:
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(d) - known
+    if unknown:
+        raise ValueError(
+            f"unknown {cls.__name__} field(s) {sorted(unknown)}; known: {sorted(known)}"
+        )
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in d:
+            continue
+        v = d[f.name]
+        if f.name in _NESTED:
+            if not isinstance(v, dict):
+                raise TypeError(
+                    f"config section '{f.name}' must be a table/object, got {type(v).__name__}"
+                )
+            kwargs[f.name] = _from_dict(_NESTED[f.name], v)
+        else:
+            kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+_NESTED = {
+    "data": DataConfig,
+    "lr": LearningRateConfig,
+    "penalty": PenaltyConfig,
+    "solver": SolverConfig,
+    "parallel": ParallelConfig,
+}
+
+
+def load_config(path: str | Path) -> PSConfig:
+    """Load a PSConfig from a .json or .toml file."""
+    p = Path(path)
+    if p.suffix == ".toml":
+        import tomllib
+
+        d = tomllib.loads(p.read_text())
+    else:
+        d = json.loads(p.read_text())
+    return _from_dict(PSConfig, d)
+
+
+def config_to_dict(cfg: PSConfig) -> dict[str, Any]:
+    return dataclasses.asdict(cfg)

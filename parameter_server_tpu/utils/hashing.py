@@ -1,0 +1,57 @@
+"""Feature-id hashing into a static dense key space.
+
+The reference keeps raw 64-bit feature keys end-to-end and range-partitions
+the (sparse) key space across servers (ref: src/util/range.h EvenDivide,
+src/app/linear_method/localizer.h remaps to dense local ids per block).
+
+On TPU we need *static shapes*: raw ids are hashed once, at ingest, into a
+dense space ``[0, num_keys)`` sized to pod HBM. The hash is splitmix64's
+finalizer — invertible (bijective on uint64), cheap, and implementable
+identically in vectorized numpy (here) and C++ (native/ parser extension),
+so the two ingest paths agree bit-for-bit.
+
+Slots (the reference's feature groups, ref: src/data/proto/example.proto
+Slot ids) are mixed into the hash as a salt so distinct slots land in
+decorrelated regions of the same space.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_C1 = np.uint64(0x9E3779B97F4A7C15)
+_C2 = np.uint64(0xBF58476D1CE4E5B9)
+_C3 = np.uint64(0x94D049BB133111EB)
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer. Bijective on uint64."""
+    z = x.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        z += _C1
+        z = (z ^ (z >> np.uint64(30))) * _C2
+        z = (z ^ (z >> np.uint64(27))) * _C3
+        z = z ^ (z >> np.uint64(31))
+    return z
+
+
+def hash_keys(
+    raw_keys: np.ndarray, num_keys: int, slot_ids: np.ndarray | int = 0
+) -> np.ndarray:
+    """Hash raw 64-bit feature ids (optionally salted by slot) into [0, num_keys).
+
+    Index 0 of every table is reserved as the padding row (gradients routed
+    there are discarded), so hashed ids land in [1, num_keys).
+    """
+    if num_keys < 2:
+        raise ValueError(f"num_keys must be >= 2 (pad row + data), got {num_keys}")
+    raw = np.asarray(raw_keys, dtype=np.uint64)
+    salt = np.asarray(slot_ids, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        mixed = raw ^ (splitmix64(salt + _C1))
+    h = splitmix64(mixed)
+    usable = np.uint64(num_keys - 1)
+    return (h % usable + np.uint64(1)).astype(np.int64)
+
+
+PAD_KEY = 0  # reserved padding row in every table

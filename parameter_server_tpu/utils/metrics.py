@@ -1,0 +1,114 @@
+"""Progress reporting and metrics (reference analog: learner/sgd.h Progress
+protos merged at the scheduler + glog step tables, util/resource_usage.h
+tic/toc timers).
+
+The reference's scheduler merges per-worker ``Progress`` protos (objective,
+relative objv, AUC, nnz(w), examples/sec) every ``report_interval`` and
+prints a table. Here ``ProgressReporter`` does the same for the SPMD pod:
+workers contribute dicts, process 0 prints the table and appends JSONL.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+
+class Timer:
+    """tic/toc accumulator (ref: util/resource_usage.h)."""
+
+    def __init__(self) -> None:
+        self._t0: float | None = None
+        self.total = 0.0
+        self.count = 0
+
+    def tic(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def toc(self) -> float:
+        assert self._t0 is not None, "toc without tic"
+        dt = time.perf_counter() - self._t0
+        self.total += dt
+        self.count += 1
+        self._t0 = None
+        return dt
+
+    def __enter__(self) -> "Timer":
+        self.tic()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.toc()
+
+
+class ProgressReporter:
+    """Merge progress dicts; print a step table; append JSONL.
+
+    Columns follow the reference's printed progress (objv, relative objv,
+    AUC, nnz(w), examples/sec) plus bytes moved by collectives — the
+    reference's Postoffice per-filter byte counters become a statically
+    computed collective-traffic estimate.
+    """
+
+    _COLS = ("sec", "examples", "objv", "rel_objv", "auc", "nnz_w", "ex_per_sec")
+
+    def __init__(self, jsonl_path: str | Path | None = None, print_fn=print):
+        self._path = Path(jsonl_path) if jsonl_path else None
+        self._print = print_fn
+        self._start = time.perf_counter()
+        self._last_objv: float | None = None
+        self._header_printed = False
+        self.history: list[dict[str, Any]] = []
+
+    def report(self, **fields: Any) -> dict[str, Any]:
+        now = time.perf_counter() - self._start
+        rec: dict[str, Any] = {"sec": round(now, 3), **fields}
+        objv = fields.get("objv")
+        if objv is not None and self._last_objv not in (None, 0.0):
+            rec["rel_objv"] = (self._last_objv - objv) / abs(self._last_objv)
+        if objv is not None:
+            self._last_objv = float(objv)
+        self.history.append(rec)
+        if self._path is not None:
+            with self._path.open("a") as f:
+                f.write(json.dumps(rec) + "\n")
+        self._print_row(rec)
+        return rec
+
+    def _print_row(self, rec: dict[str, Any]) -> None:
+        if not self._header_printed:
+            self._print("  ".join(f"{c:>12}" for c in self._COLS))
+            self._header_printed = True
+        cells = []
+        for c in self._COLS:
+            v = rec.get(c, "")
+            if isinstance(v, float):
+                cells.append(f"{v:>12.5g}")
+            else:
+                cells.append(f"{v!s:>12}")
+        self._print("  ".join(cells))
+
+
+def merge_progress(reports: list[dict[str, Any]]) -> dict[str, Any]:
+    """Merge per-worker progress the way the reference scheduler does:
+    sums for counters, example-weighted means for metrics."""
+    if not reports:
+        return {}
+    out: dict[str, Any] = {}
+    n = sum(r.get("examples", 0) for r in reports)
+    out["examples"] = n
+    for k in ("objv", "auc", "logloss"):
+        pairs = [(r[k], r.get("examples", 0)) for r in reports if k in r]
+        if pairs:
+            if all(w > 0 for _, w in pairs):
+                tot = sum(w for _, w in pairs)
+                out[k] = sum(x * w for x, w in pairs) / tot
+            else:  # any report without a count: fall back to unweighted mean
+                out[k] = sum(x for x, _ in pairs) / len(pairs)
+    for k in ("nnz_w", "ex_per_sec", "bytes_pushed", "bytes_pulled"):
+        vals = [r[k] for r in reports if k in r]
+        if vals:
+            out[k] = sum(vals)
+    return out

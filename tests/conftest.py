@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX imports.
+
+This is the rebuild's analog of the reference's script/local.sh integration
+harness (spawn scheduler + N servers + M workers as processes on one host):
+multi-"node" logic runs on one host, with virtual devices standing in for
+chips. Real-TPU behavior is exercised by bench.py on hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
