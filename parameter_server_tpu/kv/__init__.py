@@ -1,0 +1,20 @@
+"""The sharded key->value model store (reference analog: src/parameter/).
+
+The reference's server side is a hash-map of Entry structs updated on Push
+and read on Pull (src/parameter/kv_map.h + per-app entries); the worker side
+is KVVector (src/parameter/kv_vector.h). Here both collapse into:
+
+- ``state``: a pytree of dense arrays over the hashed key space, sharded
+  over the ``kv`` mesh axis (the "servers"),
+- ``pull(state, idx)``: gather rows (all-gather/psum over ``kv`` in SPMD),
+- ``push(state, idx, grad)``: apply a server-side updater to the touched
+  rows (reduce over ``data``, scatter into the ``kv`` shards).
+"""
+
+from parameter_server_tpu.kv.store import KVStore  # noqa: F401
+from parameter_server_tpu.kv.updaters import (  # noqa: F401
+    Adagrad,
+    Ftrl,
+    Sgd,
+    make_updater,
+)

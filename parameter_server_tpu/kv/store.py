@@ -1,0 +1,93 @@
+"""The KV store core: functional pull/push over dense state tables.
+
+Reference analog: src/parameter/shared_parameter.h (the Push/Pull protocol)
++ src/parameter/kv_vector.h (worker-side match) + the server KV map. In the
+TPU re-expression there is no wire: ``pull`` is a row gather and ``push``
+is gather -> updater -> scatter over the touched rows only (never the full
+table, mirroring the reference's touch-only server updates).
+
+Invariants (enforced by the data layer's localizer, ref: Localizer in
+src/app/linear_method/localizer.h):
+  - ``idx`` passed to ``push`` contains each real key at most once; padding
+    slots carry ``idx == PAD_KEY (0)`` and ``grad == 0``. Duplicate real
+    keys must be pre-aggregated (segment-summed) by the caller: the updater
+    computes one *delta* per (key, grad) pair, so double-counting a key
+    would apply the nonlinear update twice.
+  - Row 0 is the pad row: it absorbs zero-gradient updates and is excluded
+    from dumps and nnz counts.
+
+The SPMD (multi-device) pull/push live in parameter_server_tpu.parallel —
+same updater objects, rows gathered from the local ``kv`` shard instead.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parameter_server_tpu.kv.updaters import Updater
+
+State = dict[str, jax.Array]
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def pull(updater: Updater, state: State, idx: jax.Array) -> jax.Array:
+    """Gather weights for (unique, padded) key indices: (U,) -> (U, vdim)."""
+    rows = {k: jnp.take(v, idx, axis=0) for k, v in state.items()}
+    return updater.weights(rows)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def push(updater: Updater, state: State, idx: jax.Array, grad: jax.Array) -> State:
+    """Apply the server updater to the touched rows; returns new state.
+
+    grad: (U, vdim) pre-aggregated gradient aligned with ``idx``.
+    """
+    rows = {k: jnp.take(v, idx, axis=0) for k, v in state.items()}
+    deltas = updater.delta(rows, grad)
+    return {k: state[k].at[idx].add(deltas[k]) for k in state}
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def materialize_weights(updater: Updater, state: State) -> jax.Array:
+    """Full (K, vdim) weight table (FTRL: lazily derived from z, n)."""
+    return updater.weights(state)
+
+
+class KVStore:
+    """Stateful convenience wrapper an app holds (one sharded "server group").
+
+    The reference app holds a KVVector bound to a SharedParameter customer id;
+    here the app holds a KVStore bound to an updater + state pytree.
+    """
+
+    def __init__(
+        self,
+        updater: Updater,
+        num_keys: int,
+        vdim: int = 1,
+        dtype: Any = jnp.float32,
+    ):
+        self.updater = updater
+        self.num_keys = int(num_keys)
+        self.vdim = int(vdim)
+        self.state: State = updater.init(self.num_keys, self.vdim, dtype)
+
+    def pull(self, idx: jax.Array) -> jax.Array:
+        return pull(self.updater, self.state, idx)
+
+    def push(self, idx: jax.Array, grad: jax.Array) -> None:
+        self.state = push(self.updater, self.state, idx, grad)
+
+    def weights(self) -> jax.Array:
+        return materialize_weights(self.updater, self.state)
+
+    def nnz(self, tol: float = 0.0) -> int:
+        """Count of nonzero weights excluding the pad row (ref: nnz(w) in
+        the scheduler's progress table)."""
+        w = np.asarray(self.weights())[1:]
+        return int((np.abs(w) > tol).sum())
