@@ -1,0 +1,16 @@
+"""Data ingestion (reference analog: src/data/).
+
+The reference parses text formats (libsvm / criteo / adfea) into slot-based
+Example protos, then per minibatch remaps global keys to dense local ids
+(Localizer) so workers compute with small dense indices. Here the same
+pipeline produces static-shape ``CSRBatch``es ready for jit:
+
+  text -> (label, keys, values) rows        parsers (Python + C++ ext)
+       -> hashed global ids                 utils.hashing
+       -> unique + inverse (localizer)      batch.make_csr_batch
+       -> padded CSR minibatch              CSRBatch (static B/NNZ/U)
+"""
+
+from parameter_server_tpu.data.batch import BatchBuilder, CSRBatch  # noqa: F401
+from parameter_server_tpu.data.libsvm import iter_libsvm  # noqa: F401
+from parameter_server_tpu.data.reader import MinibatchReader  # noqa: F401

@@ -1,0 +1,137 @@
+"""Static-shape CSR minibatches + the localizer.
+
+Reference analog: src/app/linear_method/localizer.h — per block/minibatch,
+``unique`` the touched global keys and remap entries to dense local ids so
+the compute kernel works on a small dense index space; the unique key list
+is what Pull/Push are issued against.
+
+TPU twist: every batch is padded to static (B, NNZ, U) so one compiled
+program serves the whole stream. Padding contract (see kv.store):
+  - ``unique_keys[0] == PAD_KEY (0)`` always; unused unique slots repeat 0.
+  - padded CSR entries have ``value == 0`` and point at unique slot 0, row 0.
+  - padded example rows have ``label == 0`` and ``example_mask == False``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from parameter_server_tpu.utils.hashing import PAD_KEY, hash_keys
+
+
+@dataclass
+class CSRBatch:
+    """One device-ready minibatch. All arrays have static shapes."""
+
+    unique_keys: np.ndarray  # (U,) int32/int64 — hashed global ids, slot 0 = pad
+    local_ids: np.ndarray  # (NNZ,) int32 — entry -> unique slot
+    row_ids: np.ndarray  # (NNZ,) int32 — entry -> example row
+    values: np.ndarray  # (NNZ,) float32
+    labels: np.ndarray  # (B,) float32 in {0, 1}
+    example_mask: np.ndarray  # (B,) bool
+    num_examples: int
+    num_unique: int  # real unique keys (including pad slot 0)
+    num_entries: int
+
+    @property
+    def shape(self) -> tuple[int, int, int]:
+        return (len(self.labels), len(self.values), len(self.unique_keys))
+
+
+class BatchBuilder:
+    """Turns parsed (label, keys, values) rows into CSRBatches.
+
+    key_mode:
+      "hash"     — splitmix64 into [1, num_keys) (production path; slots salt)
+      "identity" — key+1 used directly (exact parity runs vs sklearn; requires
+                   raw keys < num_keys - 1)
+    """
+
+    def __init__(
+        self,
+        num_keys: int,
+        batch_size: int,
+        max_nnz_per_example: int = 256,
+        unique_capacity: int | None = None,
+        key_mode: str = "hash",
+    ):
+        if key_mode not in ("hash", "identity"):
+            raise ValueError(f"bad key_mode {key_mode!r}")
+        self.num_keys = num_keys
+        self.batch_size = batch_size
+        self.nnz_capacity = batch_size * max_nnz_per_example
+        # +1 for the pad slot; capped at nnz (can't see more uniques than entries)
+        self.unique_capacity = unique_capacity or min(
+            self.nnz_capacity + 1, num_keys
+        )
+        self.key_mode = key_mode
+
+    def build(
+        self,
+        labels: np.ndarray,
+        keys: list[np.ndarray],
+        values: list[np.ndarray],
+        slot_ids: list[np.ndarray] | None = None,
+    ) -> CSRBatch:
+        """labels: (b,); keys[i]/values[i]: per-example sparse features."""
+        b = len(labels)
+        if b > self.batch_size:
+            raise ValueError(f"{b} examples > batch_size {self.batch_size}")
+        counts = np.array([len(k) for k in keys], dtype=np.int64)
+        nnz = int(counts.sum())
+        if nnz > self.nnz_capacity:
+            raise ValueError(f"{nnz} entries > nnz capacity {self.nnz_capacity}")
+
+        flat_keys = (
+            np.concatenate(keys) if nnz else np.zeros(0, dtype=np.uint64)
+        )
+        flat_vals = (
+            np.concatenate(values).astype(np.float32)
+            if nnz
+            else np.zeros(0, dtype=np.float32)
+        )
+        row_ids = np.repeat(np.arange(b, dtype=np.int32), counts)
+
+        if self.key_mode == "hash":
+            salts = (
+                np.concatenate(slot_ids) if slot_ids is not None else 0
+            )
+            gids = hash_keys(flat_keys, self.num_keys, slot_ids=salts)
+        else:
+            gids = np.asarray(flat_keys, dtype=np.int64) + 1
+            if nnz and gids.max() >= self.num_keys:
+                raise ValueError(
+                    f"identity key {gids.max() - 1} >= num_keys-1; "
+                    "grow num_keys or use key_mode='hash'"
+                )
+
+        # Localizer: unique + inverse, with the pad key forced into slot 0.
+        uniq, inverse = np.unique(gids, return_inverse=True)
+        uniq = np.concatenate([[PAD_KEY], uniq]).astype(np.int64)
+        inverse = (inverse + 1).astype(np.int32)
+        n_uniq = len(uniq)
+        if n_uniq > self.unique_capacity:
+            raise ValueError(
+                f"{n_uniq} unique keys > capacity {self.unique_capacity}"
+            )
+
+        out = CSRBatch(
+            unique_keys=np.zeros(self.unique_capacity, dtype=np.int64),
+            local_ids=np.zeros(self.nnz_capacity, dtype=np.int32),
+            row_ids=np.zeros(self.nnz_capacity, dtype=np.int32),
+            values=np.zeros(self.nnz_capacity, dtype=np.float32),
+            labels=np.zeros(self.batch_size, dtype=np.float32),
+            example_mask=np.zeros(self.batch_size, dtype=bool),
+            num_examples=b,
+            num_unique=n_uniq,
+            num_entries=nnz,
+        )
+        out.unique_keys[:n_uniq] = uniq
+        out.local_ids[:nnz] = inverse
+        out.row_ids[:nnz] = row_ids
+        out.values[:nnz] = flat_vals
+        out.labels[:b] = np.asarray(labels, dtype=np.float32)
+        out.example_mask[:b] = True
+        return out

@@ -1,0 +1,94 @@
+"""libsvm / criteo text parsers — Python reference implementations.
+
+Reference analog: src/data/text_parser.cc (libsvm, criteo, adfea formats,
+slot-aware). The C++ fast path lives in native/parser.cpp and must produce
+bit-identical output (same hashing; see utils.hashing). This module is the
+correctness reference and the fallback when the extension isn't built.
+"""
+
+from __future__ import annotations
+
+import gzip
+from collections.abc import Iterator
+from pathlib import Path
+
+import numpy as np
+
+Row = tuple[float, np.ndarray, np.ndarray, np.ndarray]  # label, keys, vals, slots
+
+
+def _open(path: str | Path):
+    p = Path(path)
+    if p.suffix == ".gz":
+        return gzip.open(p, "rt")
+    return p.open("r")
+
+
+def iter_libsvm(path: str | Path) -> Iterator[Row]:
+    """Parse ``label idx:val idx:val ...``; labels -1/0/+1 -> 0/1.
+
+    Ref: ParseLibsvm in src/data/text_parser.cc. Slot id is 0 for all
+    features (libsvm has no feature groups).
+    """
+    with _open(path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            label = 1.0 if float(parts[0]) > 0 else 0.0
+            n = len(parts) - 1
+            keys = np.empty(n, dtype=np.uint64)
+            vals = np.empty(n, dtype=np.float32)
+            for i, tok in enumerate(parts[1:]):
+                k, _, v = tok.partition(":")
+                keys[i] = int(k)
+                vals[i] = float(v) if v else 1.0
+            yield label, keys, vals, np.zeros(n, dtype=np.uint64)
+
+
+def iter_criteo(path: str | Path) -> Iterator[Row]:
+    """Parse Criteo CTR TSV: label, 13 integer slots, 26 categorical slots.
+
+    Ref: ParseCriteo in src/data/text_parser.cc. Integer slot j becomes key
+    ``raw value`` in slot j+1; categorical slot j becomes its hex id in slot
+    j+14 — the slot salt keeps columns decorrelated in the hashed space.
+    Missing fields are skipped (reference behavior).
+    """
+    with _open(path) as f:
+        for line in f:
+            cols = line.rstrip("\n").split("\t")
+            if len(cols) < 40:
+                continue
+            label = 1.0 if cols[0] == "1" else 0.0
+            keys, vals, slots = [], [], []
+            for j in range(13):  # integer features: log-ish value encoding
+                c = cols[1 + j]
+                if c == "":
+                    continue
+                x = int(c)
+                keys.append(j)  # one weight per integer column...
+                vals.append(np.sign(x) * np.log1p(abs(x)))  # ...scaled by value
+                slots.append(j + 1)
+            for j in range(26):  # categorical: one-hot by hashed id
+                c = cols[14 + j]
+                if c == "":
+                    continue
+                keys.append(int(c, 16))
+                vals.append(1.0)
+                slots.append(j + 14)
+            n = len(keys)
+            yield (
+                label,
+                np.array(keys, dtype=np.uint64),
+                np.array(vals, dtype=np.float32),
+                np.array(slots, dtype=np.uint64),
+            )
+
+
+FORMATS = {"libsvm": iter_libsvm, "criteo": iter_criteo}
+
+
+def iter_format(fmt: str, path: str | Path) -> Iterator[Row]:
+    if fmt not in FORMATS:
+        raise ValueError(f"unknown data format {fmt!r}; known: {sorted(FORMATS)}")
+    return FORMATS[fmt](path)

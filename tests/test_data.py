@@ -1,0 +1,176 @@
+"""Data layer tests: parsers, localizer/batch builder, reader.
+
+Reference test analog: text-parser golden cases + localizer behavior."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data.batch import BatchBuilder
+from parameter_server_tpu.data.libsvm import iter_criteo, iter_format, iter_libsvm
+from parameter_server_tpu.data.reader import MinibatchReader
+from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
+from parameter_server_tpu.utils.hashing import PAD_KEY
+
+
+class TestLibsvm:
+    def test_golden(self, tmp_path):
+        p = tmp_path / "a.svm"
+        p.write_text("+1 3:0.5 7:2\n-1 1:1\n0 2:1\n")
+        rows = list(iter_libsvm(p))
+        assert [r[0] for r in rows] == [1.0, 0.0, 0.0]
+        np.testing.assert_array_equal(rows[0][1], [3, 7])
+        np.testing.assert_allclose(rows[0][2], [0.5, 2.0])
+
+    def test_gzip_and_bare_keys(self, tmp_path):
+        import gzip
+
+        p = tmp_path / "a.svm.gz"
+        with gzip.open(p, "wt") as f:
+            f.write("1 5:1.5\n")
+        (label, keys, vals, slots) = next(iter_libsvm(p))
+        assert label == 1.0 and keys[0] == 5 and vals[0] == 1.5
+
+    def test_unknown_format(self):
+        with pytest.raises(ValueError, match="unknown data format"):
+            iter_format("vw", "x")
+
+
+class TestCriteo:
+    def test_golden(self, tmp_path):
+        p = tmp_path / "c.tsv"
+        ints = ["1", "", "300"] + [""] * 10
+        cats = ["a1b2", ""] + ["ff"] * 24
+        p.write_text("\t".join(["1"] + ints + cats) + "\n")
+        (label, keys, vals, slots) = next(iter_criteo(p))
+        assert label == 1.0
+        # 2 present ints + 25 present cats
+        assert len(keys) == 2 + 25
+        assert slots[0] == 1 and slots[1] == 3  # integer slots are 1-based
+        assert keys[2] == int("a1b2", 16) and vals[2] == 1.0
+        assert vals[1] == pytest.approx(np.log1p(300))
+
+    def test_short_line_skipped(self, tmp_path):
+        p = tmp_path / "c.tsv"
+        p.write_text("1\tgarbage\n")
+        assert list(iter_criteo(p)) == []
+
+
+class TestBatchBuilder:
+    def test_localizer_identity_roundtrip(self):
+        b = BatchBuilder(num_keys=100, batch_size=4, key_mode="identity")
+        batch = b.build(
+            np.array([1.0, 0.0]),
+            keys=[np.array([5, 9], dtype=np.uint64), np.array([9], dtype=np.uint64)],
+            values=[np.array([1.0, 2.0], dtype=np.float32), np.array([3.0], dtype=np.float32)],
+        )
+        # uniques: pad + {6, 10}  (identity adds 1)
+        assert batch.num_unique == 3
+        assert batch.unique_keys[0] == PAD_KEY
+        assert list(batch.unique_keys[1:3]) == [6, 10]
+        # entry->unique mapping reconstructs the original keys
+        got = batch.unique_keys[batch.local_ids[: batch.num_entries]] - 1
+        np.testing.assert_array_equal(got, [5, 9, 9])
+        np.testing.assert_array_equal(batch.row_ids[: batch.num_entries], [0, 0, 1])
+        assert batch.example_mask.sum() == 2
+
+    def test_duplicate_keys_share_unique_slot(self):
+        b = BatchBuilder(num_keys=1 << 16, batch_size=2)
+        batch = b.build(
+            np.array([1.0]),
+            keys=[np.array([42, 42, 7], dtype=np.uint64)],
+            values=[np.ones(3, dtype=np.float32)],
+        )
+        ids = batch.local_ids[:3]
+        assert ids[0] == ids[1] != ids[2]
+
+    def test_padding_is_inert(self):
+        b = BatchBuilder(num_keys=64, batch_size=8, key_mode="identity")
+        batch = b.build(
+            np.array([1.0]), [np.array([3], dtype=np.uint64)], [np.ones(1, np.float32)]
+        )
+        nnz = batch.num_entries
+        assert (batch.values[nnz:] == 0).all()
+        assert (batch.local_ids[nnz:] == 0).all()
+        assert (batch.labels[1:] == 0).all() and not batch.example_mask[1:].any()
+
+    def test_capacity_errors(self):
+        b = BatchBuilder(num_keys=64, batch_size=2, max_nnz_per_example=2)
+        with pytest.raises(ValueError, match="> batch_size"):
+            b.build(np.zeros(3), [np.zeros(0, np.uint64)] * 3, [np.zeros(0, np.float32)] * 3)
+        with pytest.raises(ValueError, match="nnz capacity"):
+            b.build(
+                np.zeros(1),
+                [np.arange(5, dtype=np.uint64)],
+                [np.ones(5, np.float32)],
+            )
+        with pytest.raises(ValueError, match="identity key"):
+            BatchBuilder(num_keys=4, batch_size=1, key_mode="identity").build(
+                np.zeros(1), [np.array([99], dtype=np.uint64)], [np.ones(1, np.float32)]
+            )
+
+
+class TestReader:
+    def _write(self, tmp_path, n=100, seed=0):
+        labels, keys, vals, _ = make_sparse_logistic(n, 50, nnz_per_example=5, seed=seed)
+        p = tmp_path / f"part-{seed}.svm"
+        write_libsvm(p, labels, keys, vals)
+        return p, labels
+
+    def test_stream_covers_all_examples(self, tmp_path):
+        p, labels = self._write(tmp_path, n=100)
+        builder = BatchBuilder(num_keys=1 << 12, batch_size=32)
+        got = sum(
+            b.num_examples
+            for b in MinibatchReader([p], "libsvm", builder)
+        )
+        assert got == 100
+
+    def test_epochs_and_file_sharding(self, tmp_path):
+        p0, _ = self._write(tmp_path, seed=0)
+        p1, _ = self._write(tmp_path, seed=1)
+        builder = BatchBuilder(num_keys=1 << 12, batch_size=64)
+        n_all = sum(
+            b.num_examples
+            for b in MinibatchReader([p0, p1], "libsvm", builder, epochs=2)
+        )
+        assert n_all == 2 * 200
+        n_w0 = sum(
+            b.num_examples
+            for b in MinibatchReader(
+                [p0, p1], "libsvm", builder, worker_id=0, num_workers=2
+            )
+        )
+        n_w1 = sum(
+            b.num_examples
+            for b in MinibatchReader(
+                [p0, p1], "libsvm", builder, worker_id=1, num_workers=2
+            )
+        )
+        assert n_w0 == n_w1 == 100
+
+    def test_parser_error_propagates(self, tmp_path):
+        p = tmp_path / "bad.svm"
+        p.write_text("1 notanumber\n")
+        builder = BatchBuilder(num_keys=64, batch_size=4)
+        with pytest.raises(ValueError):
+            list(MinibatchReader([p], "libsvm", builder))
+
+    def test_no_files(self):
+        with pytest.raises(ValueError, match="no input files"):
+            MinibatchReader([], "libsvm", BatchBuilder(64, 4))
+
+    def test_abandoned_iteration_does_not_leak_producer(self, tmp_path):
+        import threading
+
+        p, _ = self._write(tmp_path, n=200)
+        builder = BatchBuilder(num_keys=1 << 12, batch_size=8)
+        before = threading.active_count()
+        for _ in range(5):
+            for b in MinibatchReader([p], "libsvm", builder, prefetch=1):
+                break  # abandon immediately with a full prefetch queue
+        import time
+
+        deadline = time.monotonic() + 5
+        while threading.active_count() > before and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before
