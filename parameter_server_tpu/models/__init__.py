@@ -1,0 +1,1 @@
+"""Applications (reference analog: src/app/)."""

@@ -1,0 +1,7 @@
+"""Device kernels: CSR segment ops, losses, and (later) Pallas fusions."""
+
+from parameter_server_tpu.ops.sparse import (  # noqa: F401
+    csr_grad,
+    csr_logits,
+    logistic_loss,
+)

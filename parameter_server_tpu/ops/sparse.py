@@ -1,0 +1,56 @@
+"""Sparse CSR compute as XLA segment ops.
+
+Reference analog: the two hot loops of the async SGD worker
+(src/app/linear_method/async_sgd.h): the CSR sparse matvec ``p = X w`` and
+its transpose ``g = X^T (sigma(p) - y)``. On TPU both are static-shape
+``segment_sum``s over the flattened CSR entry list — XLA lowers these to
+sorted-scatter, and padding entries (value 0 -> slot/row 0) vanish
+arithmetically instead of via masks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def csr_logits(
+    w_u: jax.Array,  # (U,) or (U, 1) weights for the batch's unique keys
+    values: jax.Array,  # (NNZ,)
+    local_ids: jax.Array,  # (NNZ,) entry -> unique slot
+    row_ids: jax.Array,  # (NNZ,) entry -> example row
+    num_rows: int,
+) -> jax.Array:
+    """p[i] = sum_j X[i,j] * w[j] over the batch's CSR entries -> (B,)."""
+    w_flat = w_u.reshape(-1)
+    contrib = values * jnp.take(w_flat, local_ids)
+    return jax.ops.segment_sum(contrib, row_ids, num_segments=num_rows)
+
+
+def csr_grad(
+    err: jax.Array,  # (B,) per-example residual, already masked
+    values: jax.Array,
+    local_ids: jax.Array,
+    row_ids: jax.Array,
+    num_unique: int,
+) -> jax.Array:
+    """g[u] = sum_i X[i,u] * err[i] -> (U, 1), aligned with unique_keys.
+
+    This is the pre-aggregation (segment sum over duplicate keys) that the
+    kv push contract requires."""
+    contrib = values * jnp.take(err, row_ids)
+    g = jax.ops.segment_sum(contrib, local_ids, num_segments=num_unique)
+    return g[:, None]
+
+
+def logistic_loss(
+    logits: jax.Array, labels: jax.Array, mask: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Masked summed logloss and the residual (p - y) * mask.
+
+    Ref: logit loss in src/app/linear_method/loss.h. Stable formulation:
+    log(1+e^x) - y*x = softplus(x) - y*x."""
+    m = mask.astype(logits.dtype)
+    loss = jnp.sum(m * (jax.nn.softplus(logits) - labels * logits))
+    err = (jax.nn.sigmoid(logits) - labels) * m
+    return loss, err

@@ -1,0 +1,133 @@
+"""End-to-end flagship tests: sparse LR learns, and matches an independent
+CPU baseline (sklearn logistic regression) on held-out AUC.
+
+Reference test analog: the de-facto integration test of the reference is
+"run L1-LR on rcv1 via script/local.sh and check the objective/AUC" — here
+the dataset is synthetic (no network) and the baseline is sklearn."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data.batch import BatchBuilder
+from parameter_server_tpu.data.synthetic import make_sparse_logistic
+from parameter_server_tpu.models import metrics as M
+from parameter_server_tpu.models.linear import LinearMethod
+from parameter_server_tpu.utils.config import PSConfig
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+
+def batches_of(labels, keys, vals, builder, bs):
+    out = []
+    for i in range(0, len(labels), bs):
+        out.append(
+            builder.build(labels[i : i + bs], keys[i : i + bs], vals[i : i + bs])
+        )
+    return out
+
+
+def make_dataset(n=4000, d=200, seed=0):
+    return make_sparse_logistic(n, d, nnz_per_example=12, noise=0.3, seed=seed)
+
+
+def quiet_reporter():
+    return ProgressReporter(print_fn=lambda *_: None)
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    labels, keys, vals, true_w = make_dataset()
+    n_train = 3000
+    return {
+        "train": (labels[:n_train], keys[:n_train], vals[:n_train]),
+        "test": (labels[n_train:], keys[n_train:], vals[n_train:]),
+    }
+
+
+def run_solver(dataset, algo, epochs=3, **cfg_kw):
+    cfg = PSConfig()
+    cfg.solver.algo = algo
+    cfg.solver.minibatch = 256
+    cfg.data.num_keys = 256  # identity mode: features < 255
+    cfg.penalty.lambda_l1 = cfg_kw.pop("lambda_l1", 0.1)
+    cfg.lr.alpha = cfg_kw.pop("alpha", 0.3)
+    cfg.lr.eta = cfg_kw.pop("eta", 0.3)
+    app = LinearMethod(cfg, reporter=quiet_reporter())
+    builder = app.make_builder(key_mode="identity")
+    yb, kb, vb = dataset["train"]
+    train_batches = batches_of(yb, kb, vb, builder, 256)
+    for _ in range(epochs):
+        app.train(train_batches)
+    yt, kt, vt = dataset["test"]
+    test_batches = batches_of(yt, kt, vt, builder, 256)
+    return app, app.evaluate(test_batches)
+
+
+@pytest.fixture(scope="module")
+def sklearn_auc(dataset):
+    from scipy.sparse import csr_matrix
+    from sklearn.linear_model import LogisticRegression
+
+    def to_csr(y, keys, vals, d=256):
+        rows = np.repeat(np.arange(len(y)), [len(k) for k in keys])
+        cols = np.concatenate(keys).astype(int)
+        data = np.concatenate(vals)
+        return csr_matrix((data, (rows, cols)), shape=(len(y), d))
+
+    Xtr = to_csr(*dataset["train"])
+    Xte = to_csr(*dataset["test"])
+    clf = LogisticRegression(penalty="l1", C=1.0, solver="liblinear", max_iter=200)
+    clf.fit(Xtr, dataset["train"][0])
+    return M.auc(dataset["test"][0], clf.predict_proba(Xte)[:, 1])
+
+
+class TestConvergence:
+    def test_ftrl_beats_random_and_matches_sklearn(self, dataset, sklearn_auc):
+        _, ev = run_solver(dataset, "ftrl", lambda_l1=0.05)
+        assert ev["auc"] > 0.8, ev
+        assert ev["auc"] > sklearn_auc - 0.02, (ev["auc"], sklearn_auc)
+
+    def test_adagrad_converges(self, dataset):
+        _, ev = run_solver(dataset, "adagrad", eta=0.3)
+        assert ev["auc"] > 0.8
+
+    def test_sgd_converges(self, dataset):
+        _, ev = run_solver(dataset, "sgd", eta=0.05)
+        assert ev["auc"] > 0.75
+
+    def test_l1_prunes_weights(self, dataset):
+        app_small, _ = run_solver(dataset, "ftrl", lambda_l1=0.01, epochs=2)
+        app_big, _ = run_solver(dataset, "ftrl", lambda_l1=5.0, epochs=2)
+        assert app_big.store.nnz() < app_small.store.nnz()
+
+    def test_progress_objv_decreases(self, dataset):
+        cfg = PSConfig()
+        cfg.solver.minibatch = 256
+        cfg.data.num_keys = 256
+        cfg.penalty.lambda_l1 = 0.05
+        rep = quiet_reporter()
+        app = LinearMethod(cfg, reporter=rep)
+        builder = app.make_builder(key_mode="identity")
+        y, k, v = dataset["train"]
+        bs = batches_of(y, k, v, builder, 256)
+        for _ in range(3):
+            app.train(bs, report_every=6)
+        objs = [r["objv"] for r in rep.history if "objv" in r]
+        assert objs[-1] < objs[0] * 0.8
+
+
+class TestMetrics:
+    def test_auc_known_values(self):
+        assert M.auc([0, 0, 1, 1], [0.1, 0.2, 0.8, 0.9]) == 1.0
+        assert M.auc([0, 1], [0.9, 0.1]) == 0.0
+        assert M.auc([0, 1, 0, 1], [0.5, 0.5, 0.5, 0.5]) == 0.5
+
+    def test_auc_matches_sklearn(self, rng):
+        from sklearn.metrics import roc_auc_score
+
+        y = rng.integers(0, 2, 500)
+        s = rng.random(500)
+        s[y == 1] += 0.1 * rng.random((y == 1).sum())
+        assert M.auc(y, s) == pytest.approx(roc_auc_score(y, s), abs=1e-12)
+
+    def test_logloss(self):
+        assert M.logloss([1, 0], [0.5, 0.5]) == pytest.approx(np.log(2))
