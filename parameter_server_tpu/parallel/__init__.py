@@ -1,0 +1,22 @@
+"""Pod runtime: mesh construction, SPMD pull/push, SSP clock, workload pool.
+
+Reference analog: src/system/ (Postoffice node registry, Executor dependency
+tracking, node groups). The N-servers x M-workers process graph collapses
+onto a 2-D device mesh:
+
+    axis "data" — worker group: each index owns a shard of examples
+    axis "kv"   — server group: each index owns a contiguous key range
+
+Push/Pull are XLA collectives on ICI instead of ZeroMQ messages; the SSP
+bounded-delay clock is a host-side gate on step dispatch.
+"""
+
+from parameter_server_tpu.parallel.mesh import make_mesh  # noqa: F401
+from parameter_server_tpu.parallel.spmd import (  # noqa: F401
+    make_spmd_predict_step,
+    make_spmd_train_step,
+    shard_state,
+    stack_batches,
+)
+from parameter_server_tpu.parallel.ssp import SSPClock  # noqa: F401
+from parameter_server_tpu.parallel.workload import WorkloadPool  # noqa: F401

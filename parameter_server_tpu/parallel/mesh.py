@@ -1,0 +1,30 @@
+"""Device mesh construction (reference analog: the scheduler's node-id /
+key-range assignment at startup, src/system/ manager+postoffice).
+
+The reference scheduler assigns roles and EvenDivides the key range over
+servers when nodes register. Here the "cluster table" is a
+``jax.sharding.Mesh`` with axes (data, kv): built once, it fixes both the
+worker sharding (data axis) and the server key ranges (kv axis — see
+utils.keyrange for the same math)."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    data_shards: int,
+    kv_shards: int,
+    devices: list[jax.Device] | None = None,
+) -> Mesh:
+    devs = list(devices) if devices is not None else list(jax.devices())
+    need = data_shards * kv_shards
+    if need > len(devs):
+        raise ValueError(
+            f"mesh {data_shards}x{kv_shards} needs {need} devices, have {len(devs)}"
+        )
+    import numpy as np
+
+    grid = np.array(devs[:need]).reshape(data_shards, kv_shards)
+    return Mesh(grid, axis_names=("data", "kv"))

@@ -1,0 +1,87 @@
+"""Bounded-staleness (SSP) clock — the consistency engine.
+
+Reference analog: src/system/executor.* — every Task carries a ``wait_time``
+dependency; the worker's Executor blocks submission of step t until the
+dependency (typically t - max_delay) has completed, yielding the tunable
+consistency spectrum: sequential/BSP (tau=0), bounded delay (tau>0),
+eventual/async (tau=inf) (ref: the OSDI'14 dependency model and the
+``max_delay`` knob of the SGD configs).
+
+On a TPU pod, collectives inside one program are synchronous, so per-step
+asynchrony moves UP a level: the host pipelines *dispatch* of jitted steps
+and this clock bounds how far any worker's dispatched step may run ahead of
+the slowest worker's completed step. JAX's async dispatch gives the overlap;
+the clock gives the bound."""
+
+from __future__ import annotations
+
+import threading
+
+
+class SSPClock:
+    """Host-side bounded-delay clock over ``num_workers`` logical workers.
+
+    Protocol per worker w at step t:
+        clock.wait(w, t)    # blocks until min_finished >= t - max_delay
+        ... issue step t ...
+        clock.finish(w, t)  # marks w's step t complete
+
+    max_delay < 0 means fully asynchronous (never block) — the reference's
+    "eventual" consistency.
+    """
+
+    def __init__(self, num_workers: int, max_delay: int):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.num_workers = num_workers
+        self.max_delay = max_delay
+        self._finished = [-1] * num_workers  # highest finished step per worker
+        self._cv = threading.Condition()
+
+    def _min_finished(self) -> int:
+        return min(self._finished)
+
+    def ready(self, worker: int, step: int) -> bool:
+        """Non-blocking: may ``worker`` start ``step`` now?"""
+        if self.max_delay < 0:
+            return True
+        with self._cv:
+            return self._min_finished() >= step - self.max_delay - 1
+
+    def wait(self, worker: int, step: int, timeout: float | None = None) -> bool:
+        """Block until ``worker`` may start ``step``. Returns False on timeout.
+
+        The gate: every worker must have finished step ``step - tau - 1``
+        (so with tau=0 a worker can be at most 1 step ahead of the slowest —
+        BSP up to pipelining, exactly the reference's wait_time semantics).
+        """
+        if self.max_delay < 0:
+            return True
+        target = step - self.max_delay - 1
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: self._min_finished() >= target, timeout=timeout
+            )
+
+    def finish(self, worker: int, step: int) -> None:
+        with self._cv:
+            if step > self._finished[worker]:
+                self._finished[worker] = step
+                self._cv.notify_all()
+
+    def progress(self) -> dict[str, int]:
+        with self._cv:
+            return {
+                "min_finished": self._min_finished(),
+                "max_finished": max(self._finished),
+            }
+
+    def state_dict(self) -> dict:
+        with self._cv:
+            return {"finished": list(self._finished), "max_delay": self.max_delay}
+
+    def load_state_dict(self, d: dict) -> None:
+        with self._cv:
+            self._finished = list(d["finished"])
+            self.max_delay = d["max_delay"]
+            self._cv.notify_all()

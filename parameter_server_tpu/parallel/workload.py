@@ -1,0 +1,87 @@
+"""Scheduler-side workload (file shard) assignment.
+
+Reference analog: src/learner/workload_pool.h — the scheduler hands data
+file shards to workers on demand, tracks completion, and can reassign a
+shard whose worker died or straggles."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Assignment:
+    workload: str
+    worker: int
+    t_assigned: float = field(default_factory=time.monotonic)
+
+
+class WorkloadPool:
+    """Thread-safe pool of named workloads (file shards)."""
+
+    def __init__(self, workloads: list[str]):
+        self._pending: list[str] = list(workloads)
+        self._active: dict[str, _Assignment] = {}
+        self._done: set[str] = set()
+        self._lock = threading.Lock()
+
+    def fetch(self, worker: int) -> str | None:
+        """Next workload for ``worker``; None when nothing is pending."""
+        with self._lock:
+            if not self._pending:
+                return None
+            w = self._pending.pop(0)
+            self._active[w] = _Assignment(w, worker)
+            return w
+
+    def finish(self, workload: str) -> None:
+        """Mark complete. A finish from a slow-but-alive worker whose shard
+        was already requeued by reassign_stragglers still counts: the work
+        is done, so drop it from pending instead of redoing it."""
+        with self._lock:
+            a = self._active.pop(workload, None)
+            if a is None:
+                if workload in self._pending:
+                    self._pending.remove(workload)
+                elif workload not in self._done:
+                    raise KeyError(f"unknown workload {workload!r}")
+            self._done.add(workload)
+
+    def reassign_stragglers(self, older_than_s: float) -> list[str]:
+        """Requeue workloads assigned longer than ``older_than_s`` ago
+        (ref: straggler / dead-worker reassignment)."""
+        now = time.monotonic()
+        requeued = []
+        with self._lock:
+            for w, a in list(self._active.items()):
+                if now - a.t_assigned > older_than_s:
+                    del self._active[w]
+                    self._pending.append(w)
+                    requeued.append(w)
+        return requeued
+
+    def reassign_worker(self, worker: int) -> list[str]:
+        """Requeue everything held by a dead worker."""
+        requeued = []
+        with self._lock:
+            for w, a in list(self._active.items()):
+                if a.worker == worker:
+                    del self._active[w]
+                    self._pending.append(w)
+                    requeued.append(w)
+        return requeued
+
+    @property
+    def all_done(self) -> bool:
+        with self._lock:
+            return not self._pending and not self._active
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "pending": len(self._pending),
+                "active": len(self._active),
+                "done": len(self._done),
+            }

@@ -8,12 +8,21 @@ chips. Real-TPU behavior is exercised by bench.py on hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env may preset a TPU platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment's site hook may have imported jax already (capturing
+# JAX_PLATFORMS=<tpu platform> at import time); override via config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8, (
+    "tests must run on the 8-device virtual CPU mesh; got " + str(jax.devices())
+)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
