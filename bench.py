@@ -1,0 +1,113 @@
+"""Benchmark: flagship sparse-LR FTRL training throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": R}
+
+value       — steady-state training examples/sec of the fused TPU step
+              (pull -> CSR grad -> FTRL push) on the available device.
+vs_baseline — speedup over a single-core numpy implementation of the exact
+              same algorithm (the reference's C++ server+worker collapse to
+              one host here; BASELINE.md records why the true reference
+              cannot be executed in this environment).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 8192
+NNZ_PER = 32
+NUM_KEYS = 1 << 20
+N_BATCHES = 12
+ALPHA, BETA, L1, L2 = 0.1, 1.0, 1.0, 0.0
+
+
+def _make_batches():
+    from parameter_server_tpu.data.batch import BatchBuilder
+    from parameter_server_tpu.data.synthetic import make_sparse_logistic
+
+    labels, keys, vals, _ = make_sparse_logistic(
+        BATCH * N_BATCHES, 1 << 18, nnz_per_example=NNZ_PER, noise=0.4, seed=7
+    )
+    builder = BatchBuilder(
+        num_keys=NUM_KEYS, batch_size=BATCH, max_nnz_per_example=4 * NNZ_PER
+    )
+    return [
+        builder.build(
+            labels[i : i + BATCH], keys[i : i + BATCH], vals[i : i + BATCH]
+        )
+        for i in range(0, BATCH * N_BATCHES, BATCH)
+    ]
+
+
+def bench_device(batches) -> float:
+    import jax
+
+    from parameter_server_tpu.kv.updaters import Ftrl
+    from parameter_server_tpu.models.linear import batch_to_device, train_step
+
+    up = Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2)
+    state = up.init(NUM_KEYS, 1)
+    dev_batches = [batch_to_device(b) for b in batches]
+    # warmup/compile
+    state, out = train_step(up, state, dev_batches[0])
+    jax.block_until_ready(out["loss_sum"])
+    t0 = time.perf_counter()
+    for b in dev_batches[1:]:
+        state, out = train_step(up, state, b)
+    jax.block_until_ready(out["loss_sum"])
+    dt = time.perf_counter() - t0
+    return BATCH * (len(dev_batches) - 1) / dt
+
+
+def bench_numpy_baseline(batches) -> float:
+    """Single-core numpy FTRL on identical batches (2 batches, extrapolated)."""
+    z = np.zeros(NUM_KEYS, dtype=np.float32)
+    n = np.zeros(NUM_KEYS, dtype=np.float32)
+    sub = batches[:2]
+    t0 = time.perf_counter()
+    for b in sub:
+        nnz, U = b.num_entries, len(b.unique_keys)
+        idx = b.unique_keys
+        # pull
+        shrunk = np.sign(z[idx]) * np.maximum(np.abs(z[idx]) - L1, 0.0)
+        w_u = -shrunk / ((BETA + np.sqrt(n[idx])) / ALPHA + L2)
+        # forward
+        contrib = b.values * w_u[b.local_ids]
+        logits = np.bincount(b.row_ids, weights=contrib, minlength=BATCH)
+        p = 1.0 / (1.0 + np.exp(-logits))
+        err = (p - b.labels) * b.example_mask
+        # grad per unique key
+        g = np.bincount(
+            b.local_ids, weights=b.values * err[b.row_ids], minlength=U
+        ).astype(np.float32)
+        # FTRL push
+        n_new = n[idx] + g * g
+        sigma = (np.sqrt(n_new) - np.sqrt(n[idx])) / ALPHA
+        z[idx] += g - sigma * w_u
+        n[idx] = n_new
+    dt = time.perf_counter() - t0
+    return BATCH * len(sub) / dt
+
+
+def main() -> None:
+    batches = _make_batches()
+    baseline = bench_numpy_baseline(batches)
+    value = bench_device(batches)
+    print(
+        json.dumps(
+            {
+                "metric": "sparse_lr_ftrl_train_throughput",
+                "value": round(value, 1),
+                "unit": "examples/sec",
+                "vs_baseline": round(value / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
