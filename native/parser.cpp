@@ -1,0 +1,207 @@
+// Native text parsers: libsvm + criteo -> flat CSR arrays.
+//
+// Reference analog: src/data/text_parser.cc (the reference parses libsvm /
+// criteo / adfea into slot-based Example protos in C++; parsing is a real
+// hot path at CTR scale). This extension keeps that path native: it turns a
+// chunk of complete text lines into flat (labels, row_splits, keys, vals,
+// slots) arrays consumed zero-copy by numpy via ctypes.
+//
+// Contract notes:
+//  - Caller passes a buffer of COMPLETE lines (the Python wrapper carries
+//    partial tails between chunks).
+//  - Outputs are caller-allocated; capacities passed in. Return value is 0
+//    on success, -1 on capacity overflow, -2 on parse error (err_line gets
+//    the 0-based index of the offending line in the chunk).
+//  - Key hashing stays on the numpy side (utils.hashing) so Python and C++
+//    ingest agree bit-for-bit by construction.
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// fast positive-integer / hex parse; returns false on junk
+inline bool parse_u64(const char*& p, const char* end, uint64_t& out) {
+  if (p >= end || !std::isdigit(static_cast<unsigned char>(*p))) return false;
+  uint64_t v = 0;
+  while (p < end && std::isdigit(static_cast<unsigned char>(*p))) {
+    v = v * 10 + static_cast<uint64_t>(*p - '0');
+    ++p;
+  }
+  out = v;
+  return true;
+}
+
+inline bool parse_hex64(const char*& p, const char* end, uint64_t& out) {
+  uint64_t v = 0;
+  const char* start = p;
+  while (p < end) {
+    char c = *p;
+    int d;
+    if (c >= '0' && c <= '9') d = c - '0';
+    else if (c >= 'a' && c <= 'f') d = c - 'a' + 10;
+    else if (c >= 'A' && c <= 'F') d = c - 'A' + 10;
+    else break;
+    v = (v << 4) | static_cast<uint64_t>(d);
+    ++p;
+  }
+  if (p == start) return false;
+  out = v;
+  return true;
+}
+
+inline double parse_float(const char*& p, const char* end) {
+  // strtod needs a NUL-terminated-ish region; lines are short, copy-free use
+  // is fine because strtod stops at the first invalid char and the buffer
+  // always ends with '\n' (guaranteed by the wrapper).
+  char* q = nullptr;
+  double v = std::strtod(p, &q);
+  p = (q && q <= end) ? q : p;
+  return v;
+}
+
+inline void skip_ws(const char*& p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t')) ++p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// libsvm: "label k:v k:v ...". Labels <= 0 -> 0, > 0 -> 1. Slot = 0.
+int ps_parse_libsvm(const char* buf, int64_t len,
+                    int64_t max_rows, int64_t max_nnz,
+                    float* labels, int64_t* row_splits,  // size max_rows+1
+                    uint64_t* keys, float* vals, uint64_t* slots,
+                    int64_t* out_rows, int64_t* out_nnz, int64_t* err_line) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t rows = 0, nnz = 0, line = 0;
+  row_splits[0] = 0;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!line_end) line_end = end;
+    skip_ws(p, line_end);
+    if (p >= line_end) {  // blank line
+      p = line_end + 1;
+      ++line;
+      continue;
+    }
+    if (rows >= max_rows) return -1;
+    double y = parse_float(p, line_end);
+    labels[rows] = y > 0 ? 1.0f : 0.0f;
+    while (true) {
+      skip_ws(p, line_end);
+      if (p >= line_end) break;
+      uint64_t k;
+      if (!parse_u64(p, line_end, k)) {
+        *err_line = line;
+        return -2;
+      }
+      float v = 1.0f;
+      if (p < line_end && *p == ':') {
+        ++p;
+        // empty value ("k:" then whitespace/EOL) means 1.0, like the Python
+        // parser; never let strtod skip leading whitespace across the EOL
+        if (p < line_end && *p != ' ' && *p != '\t') {
+          v = static_cast<float>(parse_float(p, line_end));
+        }
+      }
+      if (nnz >= max_nnz) return -1;
+      keys[nnz] = k;
+      vals[nnz] = v;
+      slots[nnz] = 0;
+      ++nnz;
+    }
+    ++rows;
+    row_splits[rows] = nnz;
+    p = line_end + 1;
+    ++line;
+  }
+  *out_rows = rows;
+  *out_nnz = nnz;
+  return 0;
+}
+
+// criteo TSV: label \t 13 ints \t 26 hex cats. Missing fields skipped.
+// Integer column j -> key j, slot j+1, value sign*log1p(|x|);
+// categorical column j -> key hex id, slot j+14, value 1.0.
+int ps_parse_criteo(const char* buf, int64_t len,
+                    int64_t max_rows, int64_t max_nnz,
+                    float* labels, int64_t* row_splits,
+                    uint64_t* keys, float* vals, uint64_t* slots,
+                    int64_t* out_rows, int64_t* out_nnz, int64_t* err_line) {
+  (void)err_line;  // criteo skips malformed lines instead of erroring
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t rows = 0, nnz = 0, line = 0;
+  row_splits[0] = 0;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!line_end) line_end = end;
+    if (p >= line_end) {
+      p = line_end + 1;
+      ++line;
+      continue;
+    }
+    // count fields first: need 40 columns; otherwise skip the line
+    int cols = 1;
+    for (const char* q = p; q < line_end; ++q)
+      if (*q == '\t') ++cols;
+    if (cols < 40) {
+      p = line_end + 1;
+      ++line;
+      continue;
+    }
+    if (rows >= max_rows) return -1;
+    labels[rows] = (*p == '1' && (p + 1 == line_end || p[1] == '\t')) ? 1.0f : 0.0f;
+    const char* f = static_cast<const char*>(memchr(p, '\t', line_end - p));
+    int col = 0;  // 0-based among the 39 feature columns
+    while (f && col < 39) {
+      ++f;  // past the tab
+      const char* fe = static_cast<const char*>(memchr(f, '\t', line_end - f));
+      const char* field_end = fe ? fe : line_end;
+      if (field_end > f) {  // non-empty
+        if (nnz >= max_nnz) return -1;
+        if (col < 13) {
+          const char* fp = f;
+          bool neg = (*fp == '-');
+          if (neg) ++fp;
+          uint64_t x;
+          // require the WHOLE field to parse: junk like "3x7" is skipped,
+          // never truncated to a prefix (both ingest paths agree on this)
+          if (parse_u64(fp, field_end, x) && fp == field_end) {
+            double lx = std::log1p(static_cast<double>(x));
+            keys[nnz] = static_cast<uint64_t>(col);
+            vals[nnz] = static_cast<float>(neg ? -lx : lx);
+            slots[nnz] = static_cast<uint64_t>(col + 1);
+            ++nnz;
+          }
+        } else {
+          const char* fp = f;
+          uint64_t h;
+          if (parse_hex64(fp, field_end, h) && fp == field_end) {
+            keys[nnz] = h;
+            vals[nnz] = 1.0f;
+            slots[nnz] = static_cast<uint64_t>(col - 13 + 14);
+            ++nnz;
+          }
+        }
+      }
+      ++col;
+      f = fe;
+    }
+    ++rows;
+    row_splits[rows] = nnz;
+    p = line_end + 1;
+    ++line;
+  }
+  *out_rows = rows;
+  *out_nnz = nnz;
+  return 0;
+}
+
+}  // extern "C"

@@ -76,28 +76,44 @@ class BatchBuilder:
         slot_ids: list[np.ndarray] | None = None,
     ) -> CSRBatch:
         """labels: (b,); keys[i]/values[i]: per-example sparse features."""
+        counts = np.array([len(k) for k in keys], dtype=np.int64)
+        row_splits = np.zeros(len(labels) + 1, dtype=np.int64)
+        np.cumsum(counts, out=row_splits[1:])
+        nnz = int(row_splits[-1])
+        return self.build_flat(
+            np.asarray(labels),
+            row_splits,
+            np.concatenate(keys) if nnz else np.zeros(0, dtype=np.uint64),
+            (
+                np.concatenate(values).astype(np.float32)
+                if nnz
+                else np.zeros(0, dtype=np.float32)
+            ),
+            np.concatenate(slot_ids) if slot_ids is not None else None,
+        )
+
+    def build_flat(
+        self,
+        labels: np.ndarray,
+        row_splits: np.ndarray,
+        flat_keys: np.ndarray,
+        flat_vals: np.ndarray,
+        flat_slots: np.ndarray | None = None,
+    ) -> CSRBatch:
+        """Vectorized build from flat CSR arrays (the native-parser path)."""
         b = len(labels)
         if b > self.batch_size:
             raise ValueError(f"{b} examples > batch_size {self.batch_size}")
-        counts = np.array([len(k) for k in keys], dtype=np.int64)
-        nnz = int(counts.sum())
+        nnz = int(row_splits[-1])
         if nnz > self.nnz_capacity:
             raise ValueError(f"{nnz} entries > nnz capacity {self.nnz_capacity}")
-
-        flat_keys = (
-            np.concatenate(keys) if nnz else np.zeros(0, dtype=np.uint64)
+        flat_vals = np.asarray(flat_vals, dtype=np.float32)
+        row_ids = np.repeat(
+            np.arange(b, dtype=np.int32), np.diff(row_splits).astype(np.int64)
         )
-        flat_vals = (
-            np.concatenate(values).astype(np.float32)
-            if nnz
-            else np.zeros(0, dtype=np.float32)
-        )
-        row_ids = np.repeat(np.arange(b, dtype=np.int32), counts)
 
         if self.key_mode == "hash":
-            salts = (
-                np.concatenate(slot_ids) if slot_ids is not None else 0
-            )
+            salts = flat_slots if flat_slots is not None else 0
             gids = hash_keys(flat_keys, self.num_keys, slot_ids=salts)
         else:
             gids = np.asarray(flat_keys, dtype=np.int64) + 1

@@ -63,9 +63,10 @@ def iter_criteo(path: str | Path) -> Iterator[Row]:
             keys, vals, slots = [], [], []
             for j in range(13):  # integer features: log-ish value encoding
                 c = cols[1 + j]
-                if c == "":
-                    continue
-                x = int(c)
+                try:
+                    x = int(c)
+                except ValueError:
+                    continue  # malformed fields are skipped (ref behavior)
                 keys.append(j)  # one weight per integer column...
                 vals.append(np.sign(x) * np.log1p(abs(x)))  # ...scaled by value
                 slots.append(j + 1)
@@ -73,7 +74,11 @@ def iter_criteo(path: str | Path) -> Iterator[Row]:
                 c = cols[14 + j]
                 if c == "":
                     continue
-                keys.append(int(c, 16))
+                try:
+                    k = int(c, 16)
+                except ValueError:
+                    continue
+                keys.append(k)
                 vals.append(1.0)
                 slots.append(j + 14)
             n = len(keys)

@@ -1,0 +1,156 @@
+"""ctypes bindings for the native (C++) text parsers.
+
+Reference analog: src/data/text_parser.cc — the reference's parsing is
+C++; this keeps the rebuild's ingest hot path native too. The extension is
+built on demand with ``make`` (g++); if unavailable, callers fall back to
+the Python parsers in data/libsvm.py, which produce identical rows.
+
+Chunked protocol: files are read in ~8 MiB chunks cut at line boundaries;
+each chunk is parsed in one C call into flat CSR arrays (labels,
+row_splits, keys, vals, slots)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from collections.abc import Iterator
+from pathlib import Path
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_ENV = "PS_TPU_NATIVE_LIB"
+
+FlatRows = tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+# (labels (R,), row_splits (R+1,), keys (N,), vals (N,), slots (N,))
+
+_lib: ctypes.CDLL | None = None
+_lib_tried = False
+
+
+def _build() -> Path | None:
+    so = _NATIVE_DIR / "libpsdata.so"
+    src = _NATIVE_DIR / "parser.cpp"
+    if not src.exists():  # deployed artifact without sources: use as-is
+        return so if so.exists() else None
+    if so.exists() and so.stat().st_mtime >= src.stat().st_mtime:
+        return so
+    try:
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return so if so.exists() else None
+    except (subprocess.SubprocessError, OSError):
+        return None
+
+
+def load_native() -> ctypes.CDLL | None:
+    """Load (building if needed) the native parser library, or None."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    path = os.environ.get(_LIB_ENV)
+    so = Path(path) if path else _build()
+    if so is None or not Path(so).exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+    except OSError:
+        return None
+    i64, u64p = ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64)
+    f32p, i64p = ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64)
+    for fn in ("ps_parse_libsvm", "ps_parse_criteo"):
+        f = getattr(lib, fn)
+        f.restype = ctypes.c_int
+        f.argtypes = [
+            ctypes.c_char_p, i64,  # buf, len
+            i64, i64,  # max_rows, max_nnz
+            f32p, i64p,  # labels, row_splits
+            u64p, f32p, u64p,  # keys, vals, slots
+            i64p, i64p, i64p,  # out_rows, out_nnz, err_line
+        ]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def parse_chunk(fmt: str, chunk: bytes, max_rows_hint: int = 0) -> FlatRows:
+    """Parse a buffer of complete lines via the C parser."""
+    lib = load_native()
+    if lib is None:
+        raise RuntimeError("native parser not available")
+    if not chunk.endswith(b"\n"):
+        chunk += b"\n"
+    # capacity heuristics: a row is >= 4 bytes; an entry is >= 2 bytes
+    max_rows = max(max_rows_hint, chunk.count(b"\n") + 1)
+    max_nnz = max(64, len(chunk) // 2)
+    labels = np.empty(max_rows, dtype=np.float32)
+    row_splits = np.empty(max_rows + 1, dtype=np.int64)
+    keys = np.empty(max_nnz, dtype=np.uint64)
+    vals = np.empty(max_nnz, dtype=np.float32)
+    slots = np.empty(max_nnz, dtype=np.uint64)
+    out_rows = ctypes.c_int64()
+    out_nnz = ctypes.c_int64()
+    err_line = ctypes.c_int64(-1)
+    fn = lib.ps_parse_libsvm if fmt == "libsvm" else lib.ps_parse_criteo
+    if fmt not in ("libsvm", "criteo"):
+        raise ValueError(f"native parser: unknown format {fmt!r}")
+    rc = fn(
+        chunk,
+        len(chunk),
+        max_rows,
+        max_nnz,
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        row_splits.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.byref(out_rows),
+        ctypes.byref(out_nnz),
+        ctypes.byref(err_line),
+    )
+    if rc == -1:
+        raise RuntimeError("native parser capacity overflow (internal bug)")
+    if rc == -2:
+        raise ValueError(f"parse error at line {err_line.value} of chunk ({fmt})")
+    r, n = out_rows.value, out_nnz.value
+    return (
+        labels[:r].copy(),
+        row_splits[: r + 1].copy(),
+        keys[:n].copy(),
+        vals[:n].copy(),
+        slots[:n].copy(),
+    )
+
+
+def iter_chunks(
+    path: str | Path, fmt: str, chunk_bytes: int = 8 << 20
+) -> Iterator[FlatRows]:
+    """Stream a text file (optionally .gz) through the native parser."""
+    import gzip
+
+    p = Path(path)
+    opener = gzip.open if p.suffix == ".gz" else open
+    with opener(p, "rb") as f:
+        tail = b""
+        while True:
+            buf = f.read(chunk_bytes)
+            if not buf:
+                if tail.strip():
+                    yield parse_chunk(fmt, tail)
+                return
+            buf = tail + buf
+            cut = buf.rfind(b"\n")
+            if cut < 0:
+                tail = buf
+                continue
+            tail = buf[cut + 1 :]
+            yield parse_chunk(fmt, buf[: cut + 1])

@@ -35,22 +35,100 @@ class MinibatchReader:
         worker_id: int = 0,
         num_workers: int = 1,
         drop_remainder: bool = False,
+        backend: str = "auto",  # auto | native | python
     ):
         if not files:
             raise ValueError("no input files")
+        if backend not in ("auto", "native", "python"):
+            raise ValueError(f"bad backend {backend!r}")
         self.files = [f for i, f in enumerate(sorted(map(str, files))) if i % num_workers == worker_id]
         self.fmt = fmt
         self.builder = builder
         self.epochs = epochs
         self.prefetch = prefetch
         self.drop_remainder = drop_remainder
+        from parameter_server_tpu.data import native as _native
+
+        self.use_native = backend == "native" or (
+            backend == "auto" and fmt in ("libsvm", "criteo") and _native.native_available()
+        )
+        if backend == "native" and not _native.native_available():
+            raise RuntimeError("native parser requested but not available")
 
     def _rows(self) -> Iterator:
         for _ in range(self.epochs):
             for f in self.files:
                 yield from iter_format(self.fmt, f)
 
+    def _flat_batches(self) -> Iterator[CSRBatch]:
+        """Native path: C++ chunk parse -> vectorized batch slicing."""
+        from parameter_server_tpu.data.native import iter_chunks
+
+        bs, nnz_cap = self.builder.batch_size, self.builder.nnz_capacity
+
+        def slices(flat):
+            """Yield CSRBatches of full size from ``flat``; return leftover."""
+            labels, splits, keys, vals, slots = flat
+            i = 0
+            n = len(labels)
+            while i < n:
+                # largest j with rows<=bs and entries<=nnz_cap
+                j_row = min(n, i + bs)
+                base = splits[i]
+                j = int(
+                    np.searchsorted(splits, base + nnz_cap, side="right") - 1
+                )
+                j = max(i + 1, min(j_row, j))
+                if j < n or (n - i) >= bs:
+                    yield self.builder.build_flat(
+                        labels[i:j],
+                        (splits[i : j + 1] - base),
+                        keys[base : splits[j]],
+                        vals[base : splits[j]],
+                        slots[base : splits[j]],
+                    )
+                    i = j
+                else:
+                    break  # tail smaller than a batch: keep pending
+            base = splits[i]
+            return (
+                labels[i:],
+                splits[i:] - base,
+                keys[base:],
+                vals[base:],
+                slots[base:],
+            )
+
+        def cat(a, b):
+            la, sa, ka, va, oa = a
+            lb, sb, kb, vb, ob = b
+            return (
+                np.concatenate([la, lb]),
+                np.concatenate([sa, sb[1:] + sa[-1]]),
+                np.concatenate([ka, kb]),
+                np.concatenate([va, vb]),
+                np.concatenate([oa, ob]),
+            )
+
+        leftover = None
+        for _ in range(self.epochs):
+            for f in self.files:
+                for flat in iter_chunks(f, self.fmt):
+                    merged = cat(leftover, flat) if leftover is not None else flat
+                    gen = slices(merged)
+                    while True:
+                        try:
+                            yield next(gen)
+                        except StopIteration as s:
+                            leftover = s.value
+                            break
+        if leftover is not None and len(leftover[0]) and not self.drop_remainder:
+            yield self.builder.build_flat(*leftover)
+
     def _batches(self) -> Iterator[CSRBatch]:
+        if self.use_native:
+            yield from self._flat_batches()
+            return
         labels: list[float] = []
         keys: list[np.ndarray] = []
         vals: list[np.ndarray] = []

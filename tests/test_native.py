@@ -1,0 +1,175 @@
+"""Native (C++) parser tests: build, parity with the Python parsers,
+chunked streaming, and reader integration.
+
+Reference test analog: text-parser golden cases; here the Python parser is
+the golden reference and the C++ path must agree exactly."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data import native
+from parameter_server_tpu.data.batch import BatchBuilder
+from parameter_server_tpu.data.libsvm import iter_criteo, iter_libsvm
+from parameter_server_tpu.data.reader import MinibatchReader
+from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native parser failed to build"
+)
+
+
+def rows_from_flat(flat):
+    labels, splits, keys, vals, slots = flat
+    out = []
+    for i in range(len(labels)):
+        s, e = splits[i], splits[i + 1]
+        out.append((labels[i], keys[s:e], vals[s:e], slots[s:e]))
+    return out
+
+
+def assert_rows_equal(native_rows, python_rows):
+    assert len(native_rows) == len(python_rows)
+    for (ln, kn, vn, sn), (lp, kp, vp, sp) in zip(native_rows, python_rows):
+        assert ln == lp
+        np.testing.assert_array_equal(kn, kp)
+        np.testing.assert_allclose(vn, vp, rtol=1e-6)
+        np.testing.assert_array_equal(sn, sp)
+
+
+class TestLibsvmParity:
+    def test_parity_synthetic(self, tmp_path):
+        labels, keys, vals, _ = make_sparse_logistic(500, 1000, nnz_per_example=10)
+        p = tmp_path / "d.svm"
+        write_libsvm(p, labels, keys, vals)
+        flat = native.parse_chunk("libsvm", p.read_bytes())
+        assert_rows_equal(rows_from_flat(flat), list(iter_libsvm(p)))
+
+    def test_label_variants_and_blank_lines(self, tmp_path):
+        p = tmp_path / "d.svm"
+        p.write_text("+1 3:0.5\n\n-1 1:1 2:2.5e-1\n0 7:1\n1 9\n")
+        flat = native.parse_chunk("libsvm", p.read_bytes())
+        rows = rows_from_flat(flat)
+        assert [r[0] for r in rows] == [1.0, 0.0, 0.0, 1.0]
+        assert rows[1][2][1] == pytest.approx(0.25)
+        assert rows[3][1][0] == 9 and rows[3][2][0] == 1.0  # bare key -> 1.0
+
+    def test_no_trailing_newline(self):
+        flat = native.parse_chunk("libsvm", b"1 2:3")
+        assert rows_from_flat(flat)[0][2][0] == 3.0
+
+    def test_empty_value_does_not_cross_lines(self):
+        """'k:' at EOL must read as value 1.0, never consume the next line."""
+        labels, _, keys, vals, _ = native.parse_chunk("libsvm", b"1 5:\n-1 7:2\n")
+        np.testing.assert_array_equal(labels, [1.0, 0.0])
+        np.testing.assert_array_equal(vals, [1.0, 2.0])
+        _, _, keys, vals, _ = native.parse_chunk("libsvm", b"1 5: 6:2\n")
+        np.testing.assert_array_equal(keys, [5, 6])
+        np.testing.assert_array_equal(vals, [1.0, 2.0])
+
+    def test_parse_error_reports_line(self):
+        with pytest.raises(ValueError, match="line 1"):
+            native.parse_chunk("libsvm", b"1 2:3\n1 junk:1\n")
+
+
+class TestCriteoParity:
+    def _make_file(self, tmp_path, n=200, seed=0):
+        rng = np.random.default_rng(seed)
+        lines = []
+        for _ in range(n):
+            label = str(rng.integers(0, 2))
+            ints = [
+                "" if rng.random() < 0.3 else str(int(rng.integers(-5, 10_000)))
+                for _ in range(13)
+            ]
+            cats = [
+                "" if rng.random() < 0.3 else format(int(rng.integers(0, 2**32)), "x")
+                for _ in range(26)
+            ]
+            lines.append("\t".join([label] + ints + cats))
+        p = tmp_path / "c.tsv"
+        p.write_text("\n".join(lines) + "\n")
+        return p
+
+    def test_parity_random(self, tmp_path):
+        p = self._make_file(tmp_path)
+        flat = native.parse_chunk("criteo", p.read_bytes())
+        assert_rows_equal(rows_from_flat(flat), list(iter_criteo(p)))
+
+    def test_short_lines_skipped(self):
+        flat = native.parse_chunk("criteo", b"1\tjunk\n")
+        assert len(flat[0]) == 0
+
+    def test_malformed_fields_skipped_by_both_paths(self, tmp_path):
+        """Junk like '3x7' / '12g3' is skipped whole, never prefix-parsed."""
+        row = "\t".join(["1"] + ["3x7"] + ["5"] * 12 + ["12g3"] + ["ff"] * 25)
+        p = tmp_path / "cx.tsv"
+        p.write_text(row + "\n")
+        nat = native.parse_chunk("criteo", (row + "\n").encode())
+        py = list(iter_criteo(p))
+        assert len(nat[2]) == len(py[0][1]) == 37
+        np.testing.assert_array_equal(nat[2], py[0][1])
+
+
+class TestChunkedStreaming:
+    def test_small_chunks_match_whole_file(self, tmp_path):
+        labels, keys, vals, _ = make_sparse_logistic(300, 500, nnz_per_example=8)
+        p = tmp_path / "d.svm"
+        write_libsvm(p, labels, keys, vals)
+        whole = rows_from_flat(native.parse_chunk("libsvm", p.read_bytes()))
+        chunked = []
+        for flat in native.iter_chunks(p, "libsvm", chunk_bytes=256):
+            chunked.extend(rows_from_flat(flat))
+        assert_rows_equal(chunked, whole)
+
+    def test_gzip(self, tmp_path):
+        import gzip
+
+        p = tmp_path / "d.svm.gz"
+        with gzip.open(p, "wt") as f:
+            f.write("1 5:1.5\n0 2:1\n")
+        rows = []
+        for flat in native.iter_chunks(p, "libsvm"):
+            rows.extend(rows_from_flat(flat))
+        assert len(rows) == 2 and rows[0][1][0] == 5
+
+
+class TestReaderNativeBackend:
+    def test_native_reader_matches_python_reader(self, tmp_path):
+        labels, keys, vals, _ = make_sparse_logistic(500, 800, nnz_per_example=9)
+        p = tmp_path / "d.svm"
+        write_libsvm(p, labels, keys, vals)
+        builder = BatchBuilder(num_keys=1 << 14, batch_size=64)
+        b_nat = list(MinibatchReader([p], "libsvm", builder, backend="native"))
+        b_py = list(MinibatchReader([p], "libsvm", builder, backend="python"))
+        assert sum(b.num_examples for b in b_nat) == 500
+        # same total example count and identical example content per position
+        ya = np.concatenate([b.labels[: b.num_examples] for b in b_nat])
+        yb = np.concatenate([b.labels[: b.num_examples] for b in b_py])
+        np.testing.assert_array_equal(ya, yb)
+        ka = np.concatenate(
+            [b.unique_keys[b.local_ids[: b.num_entries]] for b in b_nat]
+        )
+        kb = np.concatenate(
+            [b.unique_keys[b.local_ids[: b.num_entries]] for b in b_py]
+        )
+        np.testing.assert_array_equal(ka, kb)
+
+    def test_nnz_capacity_respected(self, tmp_path):
+        labels, keys, vals, _ = make_sparse_logistic(200, 300, nnz_per_example=20)
+        p = tmp_path / "d.svm"
+        write_libsvm(p, labels, keys, vals)
+        builder = BatchBuilder(num_keys=1 << 14, batch_size=64, max_nnz_per_example=8)
+        for b in MinibatchReader([p], "libsvm", builder, backend="native"):
+            assert b.num_entries <= builder.nnz_capacity
+            assert b.num_examples <= 64
+
+    def test_epochs(self, tmp_path):
+        labels, keys, vals, _ = make_sparse_logistic(50, 100, nnz_per_example=5)
+        p = tmp_path / "d.svm"
+        write_libsvm(p, labels, keys, vals)
+        builder = BatchBuilder(num_keys=1 << 12, batch_size=16)
+        n = sum(
+            b.num_examples
+            for b in MinibatchReader([p], "libsvm", builder, backend="native", epochs=3)
+        )
+        assert n == 150
