@@ -1,0 +1,146 @@
+"""DARLIN batch solver tests vs sklearn L1 logistic regression.
+
+Reference test analog: the reference's batch solver demo on rcv1 (L1-LR to
+convergence); baselines are liblinear (same objective) on synthetic data."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data.batch import BatchBuilder
+from parameter_server_tpu.data.synthetic import make_sparse_logistic
+from parameter_server_tpu.models import metrics as M
+from parameter_server_tpu.models.darlin import ColumnBlocks, Darlin
+from parameter_server_tpu.utils.config import PSConfig
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+NUM_KEYS = 256
+N = 2000
+
+
+@pytest.fixture(scope="module")
+def data():
+    labels, keys, vals, _ = make_sparse_logistic(
+        N, NUM_KEYS - 2, nnz_per_example=12, noise=0.3, seed=5
+    )
+    builder = BatchBuilder(
+        num_keys=NUM_KEYS, batch_size=500, key_mode="identity"
+    )
+    batches = [
+        builder.build(labels[i : i + 500], keys[i : i + 500], vals[i : i + 500])
+        for i in range(0, N, 500)
+    ]
+    return batches, labels, keys, vals
+
+
+def make_cfg(**kw):
+    cfg = PSConfig()
+    cfg.data.num_keys = NUM_KEYS
+    cfg.solver.algo = "darlin"
+    cfg.solver.feature_blocks = kw.pop("blocks", 8)
+    cfg.solver.block_iters = kw.pop("iters", 30)
+    cfg.solver.epsilon = kw.pop("epsilon", 1e-5)
+    cfg.solver.max_delay = kw.pop("max_delay", 0)
+    cfg.solver.kkt_filter_threshold = kw.pop("kkt", 0.0)
+    cfg.penalty.lambda_l1 = kw.pop("lambda_l1", 1.0)
+    cfg.lr.eta = kw.pop("eta", 1.0)
+    assert not kw
+    return cfg
+
+
+def quiet():
+    return ProgressReporter(print_fn=lambda *_: None)
+
+
+class TestColumnBlocks:
+    def test_layout_roundtrip(self, data):
+        batches, labels, keys, vals = data
+        cb = ColumnBlocks.from_batches(batches, NUM_KEYS, 8)
+        assert cb.num_examples == N
+        assert cb.n_blocks == 8
+        # total real entries match (padding is value==0)
+        total = sum(b.num_entries for b in batches)
+        assert (cb.values != 0).sum() <= total
+        # reconstruct X @ 1 (row sums) and compare with direct computation
+        rowsum = np.zeros(N)
+        for i in range(cb.n_blocks):
+            np.add.at(rowsum, cb.rows[i], cb.values[i])
+        direct = np.zeros(N)
+        for r, (k, v) in enumerate(zip(keys, vals)):
+            direct[r] += v.sum()
+        np.testing.assert_allclose(rowsum, direct, rtol=1e-4)
+
+    def test_divisibility(self, data):
+        with pytest.raises(ValueError, match="n_blocks"):
+            ColumnBlocks.from_batches(data[0], NUM_KEYS, 7)
+
+
+class TestDarlinConvergence:
+    @pytest.fixture(scope="class")
+    def sklearn_ref(self, data):
+        from scipy.sparse import csr_matrix
+        from sklearn.linear_model import LogisticRegression
+
+        batches, labels, keys, vals = data
+        rows = np.repeat(np.arange(N), [len(k) for k in keys])
+        cols = np.concatenate(keys).astype(int) + 1  # identity mode offset
+        X = csr_matrix(
+            (np.concatenate(vals), (rows, cols)), shape=(N, NUM_KEYS)
+        )
+        lam = 1.0
+        clf = LogisticRegression(
+            penalty="l1", C=1.0 / lam, solver="liblinear", max_iter=500, tol=1e-8,
+            fit_intercept=False,
+        )
+        clf.fit(X, labels)
+        w = np.zeros(NUM_KEYS)
+        w[: clf.coef_.shape[1]] = clf.coef_[0]
+        z = X @ w
+        obj = float(
+            np.sum(np.logaddexp(0, z) - labels * z) + lam * np.abs(w).sum()
+        )
+        p = 1 / (1 + np.exp(-z))
+        return {"obj": obj, "auc": M.auc(labels, p), "nnz": (w != 0).sum(), "X": X}
+
+    def test_matches_liblinear_objective(self, data, sklearn_ref):
+        batches = data[0]
+        app = Darlin(make_cfg(iters=60), reporter=quiet())
+        res = app.fit(batches, shuffle_blocks=False)
+        ours = res["history"][-1]
+        ref = sklearn_ref["obj"]
+        # within 1% of liblinear's optimum
+        assert ours < ref * 1.01, (ours, ref)
+        assert res["train_auc"] > sklearn_ref["auc"] - 0.01
+
+    def test_objective_decreases(self, data):
+        app = Darlin(make_cfg(iters=10), reporter=quiet())
+        res = app.fit(data[0], shuffle_blocks=False)
+        h = res["history"]
+        assert all(b <= a * 1.001 for a, b in zip(h, h[1:])), h
+
+    def test_l1_sparsifies(self, data):
+        res_small = Darlin(make_cfg(lambda_l1=0.1, iters=15), reporter=quiet()).fit(data[0])
+        res_big = Darlin(make_cfg(lambda_l1=10.0, iters=15), reporter=quiet()).fit(data[0])
+        assert res_big["nnz_w"] < res_small["nnz_w"]
+
+    def test_bounded_delay_still_converges(self, data, sklearn_ref):
+        app = Darlin(make_cfg(iters=60, max_delay=2), reporter=quiet())
+        res = app.fit(data[0], shuffle_blocks=False)
+        assert res["history"][-1] < sklearn_ref["obj"] * 1.02
+
+    def test_kkt_filter_converges_same(self, data, sklearn_ref):
+        app = Darlin(make_cfg(iters=60, kkt=0.1), reporter=quiet())
+        res = app.fit(data[0], shuffle_blocks=False)
+        assert res["history"][-1] < sklearn_ref["obj"] * 1.02
+
+    def test_early_stop_epsilon(self, data):
+        app = Darlin(make_cfg(iters=200, epsilon=1e-3), reporter=quiet())
+        res = app.fit(data[0])
+        assert res["iters"] < 200
+
+    def test_predict(self, data):
+        batches, labels, _, _ = data
+        app = Darlin(make_cfg(iters=20), reporter=quiet())
+        app.fit(batches)
+        p = app.predict(batches)
+        assert p.shape == (N,)
+        assert M.auc(labels, p) > 0.85
