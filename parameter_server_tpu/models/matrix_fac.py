@@ -1,0 +1,199 @@
+"""Matrix factorization over the KV store.
+
+Reference analog: the reference's matrix-factorization app (rank-r factors
+on a bipartite rating graph; workers hold rating blocks and Push/Pull the
+row/column factor vectors they touch — named in BASELINE.json's north star
+alongside linear_method).
+
+TPU re-expression: user and item factor tables are KV tables with
+``vdim = rank`` (the "value segments per key" of the reference's KVVector).
+A rating minibatch is localized exactly like sparse-LR batches: unique
+touched users/items are pulled, per-pair gradients are segment-summed onto
+the unique sets, and one fused step pushes both tables' updates."""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parameter_server_tpu.kv.store import State
+from parameter_server_tpu.kv.updaters import Adagrad, Sgd, Updater
+from parameter_server_tpu.utils.config import PSConfig
+from parameter_server_tpu.utils.hashing import PAD_KEY
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+
+@dataclass
+class MFBatch:
+    """Localized rating minibatch (static shapes)."""
+
+    user_keys: np.ndarray  # (Uu,) unique user ids (slot 0 = pad)
+    item_keys: np.ndarray  # (Ui,) unique item ids (slot 0 = pad)
+    user_ids: np.ndarray  # (B,) pair -> unique user slot
+    item_ids: np.ndarray  # (B,) pair -> unique item slot
+    ratings: np.ndarray  # (B,)
+    mask: np.ndarray  # (B,)
+    num_pairs: int
+
+
+class MFBatchBuilder:
+    """The MF localizer: unique users/items per batch, padded."""
+
+    def __init__(self, batch_size: int, user_capacity: int | None = None,
+                 item_capacity: int | None = None):
+        self.batch_size = batch_size
+        self.user_capacity = user_capacity or batch_size + 1
+        self.item_capacity = item_capacity or batch_size + 1
+
+    def build(
+        self, users: np.ndarray, items: np.ndarray, ratings: np.ndarray
+    ) -> MFBatch:
+        b = len(ratings)
+        if b > self.batch_size:
+            raise ValueError(f"{b} pairs > batch_size {self.batch_size}")
+        uu, uinv = np.unique(users, return_inverse=True)
+        ii, iinv = np.unique(items, return_inverse=True)
+        if len(uu) + 1 > self.user_capacity or len(ii) + 1 > self.item_capacity:
+            raise ValueError("unique capacity exceeded")
+        out = MFBatch(
+            user_keys=np.zeros(self.user_capacity, dtype=np.int64),
+            item_keys=np.zeros(self.item_capacity, dtype=np.int64),
+            user_ids=np.zeros(self.batch_size, dtype=np.int32),
+            item_ids=np.zeros(self.batch_size, dtype=np.int32),
+            ratings=np.zeros(self.batch_size, dtype=np.float32),
+            mask=np.zeros(self.batch_size, dtype=np.float32),
+            num_pairs=b,
+        )
+        out.user_keys[1 : len(uu) + 1] = uu + 1  # +1: key 0 is the pad row
+        out.item_keys[1 : len(ii) + 1] = ii + 1
+        out.user_ids[:b] = uinv + 1
+        out.item_ids[:b] = iinv + 1
+        out.ratings[:b] = ratings
+        out.mask[:b] = 1.0
+        assert PAD_KEY == 0
+        return out
+
+
+def batch_to_device(b: MFBatch) -> dict[str, jax.Array]:
+    return {
+        "user_keys": jnp.asarray(b.user_keys),
+        "item_keys": jnp.asarray(b.item_keys),
+        "user_ids": jnp.asarray(b.user_ids),
+        "item_ids": jnp.asarray(b.item_ids),
+        "ratings": jnp.asarray(b.ratings),
+        "mask": jnp.asarray(b.mask),
+    }
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3))
+def mf_train_step(
+    user_up: Updater,
+    item_up: Updater,
+    user_state: State,
+    item_state: State,
+    batch: dict[str, jax.Array],
+    l2: float,
+) -> tuple[State, State, jax.Array]:
+    """One fused MF step: pull touched factors, SSE gradient, push both."""
+    uk, ik = batch["user_keys"], batch["item_keys"]
+    u_rows = {k: jnp.take(v, uk, axis=0) for k, v in user_state.items()}
+    i_rows = {k: jnp.take(v, ik, axis=0) for k, v in item_state.items()}
+    U = user_up.weights(u_rows)  # (Uu, r)
+    V = item_up.weights(i_rows)  # (Ui, r)
+
+    u = jnp.take(U, batch["user_ids"], axis=0)  # (B, r)
+    v = jnp.take(V, batch["item_ids"], axis=0)
+    pred = jnp.sum(u * v, axis=1)
+    err = (pred - batch["ratings"]) * batch["mask"]
+    loss = jnp.sum(err * err)
+
+    # d/du = err * v (+ l2 u), aggregated over duplicate users in the batch
+    gu_pairs = err[:, None] * v
+    gv_pairs = err[:, None] * u
+    g_u = jax.ops.segment_sum(
+        gu_pairs, batch["user_ids"], num_segments=uk.shape[0]
+    ) + l2 * U * (jnp.arange(uk.shape[0]) > 0)[:, None]
+    g_v = jax.ops.segment_sum(
+        gv_pairs, batch["item_ids"], num_segments=ik.shape[0]
+    ) + l2 * V * (jnp.arange(ik.shape[0]) > 0)[:, None]
+
+    du = user_up.delta(u_rows, g_u)
+    dv = item_up.delta(i_rows, g_v)
+    new_user = {k: user_state[k].at[uk].add(du[k]) for k in user_state}
+    new_item = {k: item_state[k].at[ik].add(dv[k]) for k in item_state}
+    return new_user, new_item, loss
+
+
+class MatrixFactorization:
+    """The MF app. num_users/num_items rows + 1 pad row each."""
+
+    def __init__(
+        self,
+        num_users: int,
+        num_items: int,
+        rank: int = 64,
+        eta: float = 0.05,
+        l2: float = 0.01,
+        algo: str = "adagrad",
+        init_scale: float = 0.1,
+        seed: int = 0,
+        reporter: ProgressReporter | None = None,
+    ):
+        self.rank = rank
+        self.l2 = l2
+        self.reporter = reporter or ProgressReporter()
+        make = {"adagrad": lambda: Adagrad(eta=eta), "sgd": lambda: Sgd(eta=eta)}
+        if algo not in make:
+            raise ValueError(f"mf algo must be one of {sorted(make)}")
+        self.user_up = make[algo]()
+        self.item_up = make[algo]()
+        rng = np.random.default_rng(seed)
+        self.user_state = self.user_up.init(num_users + 1, rank)
+        self.item_state = self.item_up.init(num_items + 1, rank)
+        # factors start small-random (a zero product has zero gradient);
+        # pad row 0 stays zero
+        u0 = rng.normal(scale=init_scale, size=(num_users + 1, rank))
+        i0 = rng.normal(scale=init_scale, size=(num_items + 1, rank))
+        u0[0] = 0.0
+        i0[0] = 0.0
+        self.user_state["w"] = jnp.asarray(u0, dtype=jnp.float32)
+        self.item_state["w"] = jnp.asarray(i0, dtype=jnp.float32)
+
+    def train_epoch(
+        self, users, items, ratings, batch_size: int = 4096, seed: int = 0
+    ) -> float:
+        """One shuffled pass; returns train RMSE."""
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(ratings))
+        builder = MFBatchBuilder(batch_size)
+        sse, n = 0.0, 0
+        t0 = time.perf_counter()
+        for s in range(0, len(order), batch_size):
+            sel = order[s : s + batch_size]
+            b = builder.build(users[sel], items[sel], ratings[sel])
+            dev = batch_to_device(b)
+            self.user_state, self.item_state, loss = mf_train_step(
+                self.user_up, self.item_up,
+                self.user_state, self.item_state, dev, self.l2,
+            )
+            sse += float(loss)
+            n += b.num_pairs
+        rmse = float(np.sqrt(sse / max(n, 1)))
+        self.reporter.report(
+            examples=n, objv=rmse, ex_per_sec=n / max(time.perf_counter() - t0, 1e-9)
+        )
+        return rmse
+
+    def predict(self, users, items) -> np.ndarray:
+        U = np.asarray(self.user_up.weights(self.user_state))
+        V = np.asarray(self.item_up.weights(self.item_state))
+        return np.sum(U[np.asarray(users) + 1] * V[np.asarray(items) + 1], axis=1)
+
+    def rmse(self, users, items, ratings) -> float:
+        p = self.predict(users, items)
+        return float(np.sqrt(np.mean((p - ratings) ** 2)))

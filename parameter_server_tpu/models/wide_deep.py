@@ -1,0 +1,212 @@
+"""Wide & Deep CTR model with a server-sharded embedding table.
+
+Reference analog: BASELINE.json parity config "Wide-&-Deep CTR with
+100M-row embedding table (server-sharded embeddings)". The wide half IS the
+reference's sparse linear model (FTRL over the hashed key space); the deep
+half is an embedding table living in the same KV store (vdim = embedding
+dim) feeding a small MLP.
+
+Design note vs the reference: the reference hand-writes worker gradients;
+here the whole forward is one differentiable function and ``jax.grad``
+produces the pulled-row gradients, which are then pushed through the same
+server updaters (FTRL for wide, AdaGrad for embeddings, Adam for the dense
+MLP). Pull/push stay the only interface to model state."""
+
+from __future__ import annotations
+
+import functools
+import time
+from collections.abc import Iterable
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from parameter_server_tpu.data.batch import CSRBatch
+from parameter_server_tpu.kv.store import State
+from parameter_server_tpu.kv.updaters import Adagrad, Ftrl, Updater
+from parameter_server_tpu.models import metrics as M
+from parameter_server_tpu.models.linear import batch_to_device
+from parameter_server_tpu.ops.sparse import csr_logits
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+
+def init_mlp(dim: int, hidden: list[int], seed: int = 0) -> list[dict[str, Any]]:
+    rng = np.random.default_rng(seed)
+    sizes = [dim, *hidden, 1]
+    params = []
+    for fan_in, fan_out in zip(sizes, sizes[1:]):
+        params.append(
+            {
+                "W": jnp.asarray(
+                    rng.normal(scale=np.sqrt(2.0 / fan_in), size=(fan_in, fan_out)),
+                    dtype=jnp.float32,
+                ),
+                "b": jnp.zeros(fan_out, dtype=jnp.float32),
+            }
+        )
+    return params
+
+
+def _mlp_apply(params, x):
+    for layer in params[:-1]:
+        x = jax.nn.relu(x @ layer["W"] + layer["b"])
+    last = params[-1]
+    return (x @ last["W"] + last["b"])[:, 0]
+
+
+def _forward(w_u, emb_rows_w, mlp_params, b):
+    """Differentiable forward: wide logits + deep logits -> masked loss."""
+    wide = csr_logits(
+        w_u, b["values"], b["local_ids"], b["row_ids"],
+        num_rows=b["labels"].shape[0],
+    )
+    # mean-pool the batch's unique-key embeddings per example
+    ent_emb = jnp.take(emb_rows_w, b["local_ids"], axis=0)  # (NNZ, d)
+    ones = (b["values"] != 0).astype(jnp.float32)
+    num = jax.ops.segment_sum(
+        ent_emb * ones[:, None], b["row_ids"], num_segments=b["labels"].shape[0]
+    )
+    cnt = jax.ops.segment_sum(
+        ones, b["row_ids"], num_segments=b["labels"].shape[0]
+    )
+    pooled = num / jnp.maximum(cnt, 1.0)[:, None]
+    deep = _mlp_apply(mlp_params, pooled)
+    logits = wide + deep
+    m = b["example_mask"].astype(jnp.float32)
+    loss = jnp.sum(m * (jax.nn.softplus(logits) - b["labels"] * logits))
+    return loss, logits
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(3, 4))
+def wd_train_step(
+    wide_up: Updater,
+    emb_up: Updater,
+    opt: Any,  # optax optimizer (static: hashable namedtuple of fns? no — see make)
+    wide_state: State,
+    emb_state: State,
+    mlp_params: Any,
+    opt_state: Any,
+    batch: dict[str, jax.Array],
+):
+    idx = batch["unique_keys"]
+    wide_rows = {k: jnp.take(v, idx, axis=0) for k, v in wide_state.items()}
+    emb_rows = {k: jnp.take(v, idx, axis=0) for k, v in emb_state.items()}
+    w_u = wide_up.weights(wide_rows)
+    e_w = emb_up.weights(emb_rows)
+
+    (loss, logits), grads = jax.value_and_grad(
+        lambda w, e, p: _forward(w, e, p, batch), argnums=(0, 1, 2), has_aux=True
+    )(w_u, e_w, mlp_params)
+    g_wide, g_emb, g_mlp = grads
+
+    d_wide = wide_up.delta(wide_rows, g_wide)
+    new_wide = {k: wide_state[k].at[idx].add(d_wide[k]) for k in wide_state}
+    d_emb = emb_up.delta(emb_rows, g_emb)
+    new_emb = {k: emb_state[k].at[idx].add(d_emb[k]) for k in emb_state}
+
+    updates, new_opt_state = opt.update(g_mlp, opt_state, mlp_params)
+    new_mlp = optax.apply_updates(mlp_params, updates)
+    probs = jax.nn.sigmoid(logits)
+    return new_wide, new_emb, new_mlp, new_opt_state, loss, probs
+
+
+class WideDeep:
+    """The Wide&Deep app: shared hashed key space for wide + embedding."""
+
+    def __init__(
+        self,
+        num_keys: int,
+        emb_dim: int = 16,
+        hidden: list[int] | None = None,
+        ftrl_kw: dict | None = None,
+        emb_eta: float = 0.1,
+        mlp_lr: float = 1e-3,
+        seed: int = 0,
+        reporter: ProgressReporter | None = None,
+    ):
+        self.num_keys = num_keys
+        self.reporter = reporter or ProgressReporter()
+        self.wide_up = Ftrl(**(ftrl_kw or {"alpha": 0.1, "lambda_l1": 0.5}))
+        self.emb_up = Adagrad(eta=emb_eta)
+        self.wide_state = self.wide_up.init(num_keys, 1)
+        self.emb_state = self.emb_up.init(num_keys, emb_dim)
+        rng = np.random.default_rng(seed)
+        init = rng.normal(scale=0.05, size=(num_keys, emb_dim)).astype(np.float32)
+        init[0] = 0.0
+        self.emb_state["w"] = jnp.asarray(init)
+        self.mlp_params = init_mlp(emb_dim, hidden or [32, 16], seed=seed)
+        self.opt = optax.adam(mlp_lr)
+        self.opt_state = self.opt.init(self.mlp_params)
+        self.examples_seen = 0
+
+    def train(self, batches: Iterable[CSRBatch], report_every: int = 100) -> dict:
+        window_p, window_y, losses = [], [], []
+        n_since = 0
+        t0 = time.perf_counter()
+        last: dict = {}
+        for i, b in enumerate(batches):
+            dev = batch_to_device(b)
+            (
+                self.wide_state,
+                self.emb_state,
+                self.mlp_params,
+                self.opt_state,
+                loss,
+                probs,
+            ) = wd_train_step(
+                self.wide_up,
+                self.emb_up,
+                self.opt,
+                self.wide_state,
+                self.emb_state,
+                self.mlp_params,
+                self.opt_state,
+                dev,
+            )
+            self.examples_seen += b.num_examples
+            n_since += b.num_examples
+            losses.append(loss)
+            window_p.append((probs, b.num_examples))
+            window_y.append(b.labels[: b.num_examples])
+            if (i + 1) % report_every == 0:
+                last = self._flush(losses, window_p, window_y, n_since, t0)
+                losses, window_p, window_y = [], [], []
+                n_since, t0 = 0, time.perf_counter()
+        if n_since:
+            last = self._flush(losses, window_p, window_y, n_since, t0)
+        return last
+
+    def _flush(self, losses, window_p, window_y, n_since, t0):
+        loss_sum = float(sum(float(x) for x in jax.device_get(losses)))
+        p = np.concatenate([np.asarray(pr)[:n] for pr, n in window_p])
+        y = np.concatenate(window_y)
+        return self.reporter.report(
+            examples=self.examples_seen,
+            objv=loss_sum / max(n_since, 1),
+            auc=M.auc(y, p),
+            ex_per_sec=n_since / max(time.perf_counter() - t0, 1e-9),
+        )
+
+    def predict(self, batches: Iterable[CSRBatch]) -> tuple[np.ndarray, np.ndarray]:
+        ys, ps = [], []
+        for b in batches:
+            dev = batch_to_device(b)
+            idx = dev["unique_keys"]
+            wide_rows = {k: jnp.take(v, idx, axis=0) for k, v in self.wide_state.items()}
+            emb_rows = {k: jnp.take(v, idx, axis=0) for k, v in self.emb_state.items()}
+            _, logits = _forward(
+                self.wide_up.weights(wide_rows),
+                self.emb_up.weights(emb_rows),
+                self.mlp_params,
+                dev,
+            )
+            ps.append(np.asarray(jax.nn.sigmoid(logits))[: b.num_examples])
+            ys.append(b.labels[: b.num_examples])
+        return np.concatenate(ys), np.concatenate(ps)
+
+    def evaluate(self, batches: Iterable[CSRBatch]) -> dict:
+        y, p = self.predict(batches)
+        return {"auc": M.auc(y, p), "logloss": M.logloss(y, p), "examples": len(y)}

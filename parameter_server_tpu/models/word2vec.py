@@ -1,0 +1,162 @@
+"""word2vec skip-gram with negative sampling (SGNS) over the KV store.
+
+Reference analog: BASELINE.json's parity config "word2vec skip-gram
+negative-sampling (1B-word corpus, bounded-staleness SSP)" — the classic
+parameter-server workload: two huge embedding tables (input/output), each
+step touching only the batch's words, pushed with bounded staleness.
+
+TPU re-expression: in/out embedding tables are KV tables with vdim = dim;
+a step batch is (center, context, K negatives) id arrays; negatives are
+pre-sampled host-side from the unigram^0.75 distribution (the data-layer
+job, like the reference's worker-side samplers); the fused step pulls the
+touched rows, computes the SGNS loss, and pushes exact deltas."""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parameter_server_tpu.kv.store import State
+from parameter_server_tpu.kv.updaters import Adagrad, Updater
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3))
+def sgns_train_step(
+    in_up: Updater,
+    out_up: Updater,
+    in_state: State,
+    out_state: State,
+    batch: dict[str, jax.Array],  # center (B,), context (B,), negatives (B, K)
+) -> tuple[State, State, jax.Array]:
+    center, context, negatives = batch["center"], batch["context"], batch["negatives"]
+    B, K = negatives.shape
+
+    in_rows = {k: jnp.take(v, center, axis=0) for k, v in in_state.items()}
+    u = in_up.weights(in_rows)  # (B, d)
+
+    # output rows for context + negatives, flattened: (B*(1+K),)
+    out_ids = jnp.concatenate([context[:, None], negatives], axis=1).reshape(-1)
+    out_rows = {k: jnp.take(v, out_ids, axis=0) for k, v in out_state.items()}
+    v_all = out_up.weights(out_rows).reshape(B, 1 + K, -1)  # (B, 1+K, d)
+
+    logits = jnp.einsum("bd,bkd->bk", u, v_all)  # (B, 1+K)
+    labels = jnp.concatenate(
+        [jnp.ones((B, 1)), jnp.zeros((B, K))], axis=1
+    )
+    # SGNS loss: -log sig(pos) - sum log sig(-neg) == softplus formulation
+    loss = jnp.sum(jax.nn.softplus(logits) - labels * logits)
+    err = jax.nn.sigmoid(logits) - labels  # (B, 1+K)
+
+    g_u = jnp.einsum("bk,bkd->bd", err, v_all)  # (B, d)
+    g_v = err[:, :, None] * u[:, None, :]  # (B, 1+K, d)
+
+    d_in = in_up.delta(in_rows, g_u)
+    new_in = {k: in_state[k].at[center].add(d_in[k]) for k in in_state}
+    # NOTE: duplicate ids inside one batch are handled by scatter-add of
+    # deltas; each occurrence computed its delta from the same pulled row —
+    # the same within-step staleness semantics as the SPMD push path.
+    d_out = out_up.delta(
+        {k: v for k, v in out_rows.items()}, g_v.reshape(B * (1 + K), -1)
+    )
+    new_out = {k: out_state[k].at[out_ids].add(d_out[k]) for k in out_state}
+    return new_in, new_out, loss
+
+
+class NegativeSampler:
+    """unigram^0.75 table sampler (word2vec's standard trick)."""
+
+    def __init__(self, counts: np.ndarray, power: float = 0.75, seed: int = 0):
+        p = np.asarray(counts, dtype=np.float64) ** power
+        self.p = p / p.sum()
+        self.rng = np.random.default_rng(seed)
+
+    def sample(self, shape) -> np.ndarray:
+        return self.rng.choice(len(self.p), size=shape, p=self.p)
+
+
+class Word2Vec:
+    """SGNS app over vocab_size words, dim-dimensional embeddings."""
+
+    def __init__(
+        self,
+        vocab_size: int,
+        dim: int = 64,
+        eta: float = 0.3,
+        num_negatives: int = 5,
+        window: int = 2,
+        seed: int = 0,
+        reporter: ProgressReporter | None = None,
+    ):
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.K = num_negatives
+        self.window = window
+        self.reporter = reporter or ProgressReporter()
+        self.in_up = Adagrad(eta=eta)
+        self.out_up = Adagrad(eta=eta)
+        rng = np.random.default_rng(seed)
+        self.in_state = self.in_up.init(vocab_size, dim)
+        self.out_state = self.out_up.init(vocab_size, dim)
+        self.in_state["w"] = jnp.asarray(
+            rng.uniform(-0.5 / dim, 0.5 / dim, size=(vocab_size, dim)),
+            dtype=jnp.float32,
+        )
+        # output table starts at zero (standard word2vec init)
+
+    def make_pairs(self, corpus: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(center, context) skip-gram pairs within the window."""
+        centers, contexts = [], []
+        n = len(corpus)
+        for off in range(1, self.window + 1):
+            centers.append(corpus[:-off])
+            contexts.append(corpus[off:])
+            centers.append(corpus[off:])
+            contexts.append(corpus[:-off])
+        return np.concatenate(centers), np.concatenate(contexts)
+
+    def train_epoch(
+        self,
+        corpus: np.ndarray,
+        batch_size: int = 8192,
+        seed: int = 0,
+    ) -> float:
+        counts = np.bincount(corpus, minlength=self.vocab_size)
+        sampler = NegativeSampler(counts, seed=seed)
+        centers, contexts = self.make_pairs(corpus)
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(centers))
+        total_loss, n = 0.0, 0
+        t0 = time.perf_counter()
+        for s in range(0, len(order) - batch_size + 1, batch_size):
+            sel = order[s : s + batch_size]
+            batch = {
+                "center": jnp.asarray(centers[sel].astype(np.int32)),
+                "context": jnp.asarray(contexts[sel].astype(np.int32)),
+                "negatives": jnp.asarray(
+                    sampler.sample((len(sel), self.K)).astype(np.int32)
+                ),
+            }
+            self.in_state, self.out_state, loss = sgns_train_step(
+                self.in_up, self.out_up, self.in_state, self.out_state, batch
+            )
+            total_loss += float(loss)
+            n += len(sel)
+        mean = total_loss / max(n, 1)
+        self.reporter.report(
+            examples=n, objv=mean, ex_per_sec=n / max(time.perf_counter() - t0, 1e-9)
+        )
+        return mean
+
+    def embeddings(self) -> np.ndarray:
+        return np.asarray(self.in_up.weights(self.in_state))
+
+    def similarity(self, a: int, b: int) -> float:
+        E = self.embeddings()
+        x, y = E[a], E[b]
+        den = np.linalg.norm(x) * np.linalg.norm(y)
+        return float(x @ y / den) if den > 0 else 0.0

@@ -1,0 +1,140 @@
+"""Tests for the MF, word2vec, and Wide&Deep apps.
+
+Reference test analog: each parity config in BASELINE.json gets a
+small-scale convergence check against task-appropriate baselines."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data.batch import BatchBuilder
+from parameter_server_tpu.models import metrics as M
+from parameter_server_tpu.models.matrix_fac import (
+    MatrixFactorization,
+    MFBatchBuilder,
+)
+from parameter_server_tpu.models.wide_deep import WideDeep
+from parameter_server_tpu.models.word2vec import NegativeSampler, Word2Vec
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+
+def quiet():
+    return ProgressReporter(print_fn=lambda *_: None)
+
+
+def make_ratings(n_users=200, n_items=100, rank=4, n_obs=8000, noise=0.05, seed=0):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(scale=1.0 / np.sqrt(rank), size=(n_users, rank))
+    V = rng.normal(scale=1.0 / np.sqrt(rank), size=(n_items, rank))
+    users = rng.integers(0, n_users, n_obs)
+    items = rng.integers(0, n_items, n_obs)
+    r = np.sum(U[users] * V[items], axis=1) + noise * rng.normal(size=n_obs)
+    return users, items, r.astype(np.float32)
+
+
+class TestMatrixFactorization:
+    def test_recovers_low_rank_structure(self):
+        users, items, r = make_ratings()
+        n_tr = 7000
+        mf = MatrixFactorization(
+            200, 100, rank=8, eta=0.1, l2=0.002, reporter=quiet(), seed=1
+        )
+        rmse0 = mf.rmse(users[n_tr:], items[n_tr:], r[n_tr:])
+        for ep in range(30):
+            mf.train_epoch(users[:n_tr], items[:n_tr], r[:n_tr], seed=ep)
+        rmse = mf.rmse(users[n_tr:], items[n_tr:], r[n_tr:])
+        assert rmse < rmse0 * 0.5, (rmse0, rmse)
+        assert rmse < 0.25, rmse  # close to the noise floor
+
+    def test_duplicate_pairs_in_batch(self):
+        mf = MatrixFactorization(4, 4, rank=2, reporter=quiet())
+        users = np.array([1, 1, 1, 2])
+        items = np.array([0, 0, 1, 1])
+        r = np.ones(4, dtype=np.float32)
+        for _ in range(5):
+            mf.train_epoch(users, items, r, batch_size=4)
+        assert np.isfinite(mf.predict(users, items)).all()
+
+    def test_builder_capacity(self):
+        b = MFBatchBuilder(batch_size=2)
+        with pytest.raises(ValueError, match="pairs"):
+            b.build(np.arange(3), np.arange(3), np.ones(3, dtype=np.float32))
+
+    def test_bad_algo(self):
+        with pytest.raises(ValueError, match="mf algo"):
+            MatrixFactorization(4, 4, algo="ftrl")
+
+
+class TestWord2Vec:
+    def test_learns_cooccurrence_structure(self):
+        """Corpus of two 'topics': words 0-4 co-occur, words 5-9 co-occur.
+        After training, within-topic similarity >> across-topic."""
+        rng = np.random.default_rng(0)
+        chunks = []
+        for _ in range(600):
+            topic = rng.integers(0, 2)
+            words = rng.integers(0, 5, size=8) + 5 * topic
+            chunks.append(words)
+        corpus = np.concatenate(chunks)
+        w2v = Word2Vec(vocab_size=10, dim=16, eta=0.5, num_negatives=4, window=2,
+                       reporter=quiet())
+        losses = [w2v.train_epoch(corpus, batch_size=2048, seed=ep) for ep in range(8)]
+        assert losses[-1] < losses[0]
+        within = np.mean([w2v.similarity(0, i) for i in range(1, 5)])
+        across = np.mean([w2v.similarity(0, i) for i in range(5, 10)])
+        assert within > across + 0.3, (within, across)
+
+    def test_negative_sampler_distribution(self):
+        counts = np.array([100, 10, 1, 0])
+        s = NegativeSampler(counts, seed=0)
+        draw = s.sample(20000)
+        freq = np.bincount(draw, minlength=4) / 20000
+        assert freq[0] > freq[1] > freq[2]
+        assert freq[3] == 0
+
+
+class TestWideDeep:
+    @staticmethod
+    def _interaction_data(n=6000, seed=0):
+        """y = XOR of two categorical groups: invisible to a linear model."""
+        rng = np.random.default_rng(seed)
+        a = rng.integers(0, 2, n)
+        b = rng.integers(0, 2, n)
+        y = (a ^ b).astype(np.float32)
+        # features: cat A value (keys 0/1), cat B value (keys 2/3)
+        keys = [np.array([ai, 2 + bi], dtype=np.uint64) for ai, bi in zip(a, b)]
+        vals = [np.ones(2, dtype=np.float32) for _ in range(n)]
+        return y, keys, vals
+
+    def _batches(self, y, keys, vals, builder, bs=512):
+        return [
+            builder.build(y[i : i + bs], keys[i : i + bs], vals[i : i + bs])
+            for i in range(0, len(y), bs)
+        ]
+
+    def test_captures_interactions_linear_cannot(self):
+        y, keys, vals = self._interaction_data()
+        builder = BatchBuilder(num_keys=64, batch_size=512, key_mode="identity")
+        train = self._batches(y[:5000], keys[:5000], vals[:5000], builder)
+        test = self._batches(y[5000:], keys[5000:], vals[5000:], builder)
+
+        wd = WideDeep(num_keys=64, emb_dim=8, hidden=[16], mlp_lr=5e-3,
+                      reporter=quiet())
+        for _ in range(30):
+            wd.train(train, report_every=1000)
+        ev = wd.evaluate(test)
+        assert ev["auc"] > 0.9, ev  # linear AUC on XOR is ~0.5
+
+    def test_linear_fails_on_same_data(self):
+        from parameter_server_tpu.models.linear import LinearMethod
+        from parameter_server_tpu.utils.config import PSConfig
+
+        y, keys, vals = self._interaction_data()
+        builder = BatchBuilder(num_keys=64, batch_size=512, key_mode="identity")
+        train = self._batches(y[:5000], keys[:5000], vals[:5000], builder)
+        test = self._batches(y[5000:], keys[5000:], vals[5000:], builder)
+        cfg = PSConfig()
+        cfg.data.num_keys = 64
+        app = LinearMethod(cfg, reporter=quiet())
+        for _ in range(3):
+            app.train(train)
+        assert app.evaluate(test)["auc"] < 0.6
