@@ -1,0 +1,143 @@
+"""Command-line entry point.
+
+Reference analog: src/main.cc (gflags -> Postoffice -> App::Create(config)
+-> run) plus script/local.sh. The reference dispatches scheduler / server /
+worker roles as processes; on TPU the roles collapse into one SPMD program,
+so the CLI surface is: a config file picks the app and solver, flags pick
+the run mode.
+
+Usage:
+  python -m parameter_server_tpu.cli train  --app_file cfg.json [--model_out m.txt]
+  python -m parameter_server_tpu.cli evaluate --app_file cfg.json --model m.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from parameter_server_tpu.utils.config import PSConfig, load_config
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="parameter_server_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    tr = sub.add_parser("train", help="train the configured app")
+    tr.add_argument("--app_file", required=True, help="JSON/TOML PSConfig")
+    tr.add_argument("--model_out", default="", help="text model dump path")
+    tr.add_argument("--ckpt_dir", default="", help="checkpoint directory")
+    tr.add_argument("--resume", action="store_true", help="resume from ckpt_dir")
+    tr.add_argument(
+        "--report_interval", type=int, default=50, help="steps between reports"
+    )
+
+    ev = sub.add_parser("evaluate", help="evaluate a dumped model")
+    ev.add_argument("--app_file", required=True)
+    ev.add_argument("--model", required=True, help="text model dump")
+    ev.add_argument("--data", nargs="*", default=None, help="override val files")
+    return p
+
+
+def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
+    if not cfg.data.files:
+        raise SystemExit("config data.files is empty")
+    if cfg.solver.algo == "darlin":
+        from parameter_server_tpu.data.batch import BatchBuilder
+        from parameter_server_tpu.data.reader import MinibatchReader
+        from parameter_server_tpu.models.darlin import Darlin
+        from parameter_server_tpu.utils.checkpoint import (
+            dump_weights_text,
+            save_checkpoint,
+        )
+
+        if args.resume:
+            raise SystemExit(
+                "--resume is not supported for the darlin batch solver "
+                "(it restarts from its cached column blocks)"
+            )
+        app = Darlin(cfg)
+        builder = BatchBuilder(
+            num_keys=cfg.data.num_keys,
+            batch_size=cfg.solver.minibatch,
+            max_nnz_per_example=cfg.data.max_nnz_per_example,
+        )
+        batches = list(MinibatchReader(cfg.data.files, cfg.data.format, builder))
+        res = app.fit(batches)
+        if args.ckpt_dir:
+            save_checkpoint(
+                args.ckpt_dir,
+                {"w": app.w},
+                meta={"algo": "darlin", "num_keys": cfg.data.num_keys},
+            )
+        if args.model_out:
+            dump_weights_text(app.w, args.model_out)
+        out = {k: res[k] for k in ("objv", "iters", "nnz_w", "train_auc")}
+        if cfg.data.val_files:
+            val = list(
+                MinibatchReader(cfg.data.val_files, cfg.data.format, builder)
+            )
+            p = app.predict(val)
+            import numpy as np
+
+            from parameter_server_tpu.models import metrics as M
+
+            y = np.concatenate([b.labels[: b.num_examples] for b in val])
+            out["val_auc"] = M.auc(y, p)
+            out["val_logloss"] = M.logloss(y, p)
+        return out
+
+    from parameter_server_tpu.models.linear import LinearMethod
+
+    app = LinearMethod(cfg)
+    if args.resume:
+        if not args.ckpt_dir:
+            raise SystemExit("--resume requires --ckpt_dir")
+        app.load(args.ckpt_dir)
+    last = (
+        app.train_files(cfg.data.files, report_every=args.report_interval) or {}
+    )  # reader applies cfg epochs
+    if args.ckpt_dir:
+        app.save(args.ckpt_dir)
+    if args.model_out:
+        app.dump_model(args.model_out)
+    if cfg.data.val_files:
+        from parameter_server_tpu.data.reader import MinibatchReader
+
+        ev = app.evaluate(
+            MinibatchReader(cfg.data.val_files, cfg.data.format, app.make_builder())
+        )
+        last = {**last, **{f"val_{k}": v for k, v in ev.items()}}
+    return last
+
+
+def run_evaluate(cfg: PSConfig, args: argparse.Namespace) -> dict:
+    from parameter_server_tpu.models.evaluation import evaluate_model
+
+    files = args.data if args.data else (cfg.data.val_files or cfg.data.files)
+    if not files:
+        raise SystemExit("no evaluation files (config val_files/files or --data)")
+    return evaluate_model(
+        args.model,
+        files,
+        cfg.data.format,
+        cfg.data.num_keys,
+        batch_size=cfg.solver.minibatch,
+        max_nnz_per_example=cfg.data.max_nnz_per_example,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    cfg = load_config(args.app_file)
+    if args.cmd == "train":
+        out = run_train(cfg, args)
+    else:
+        out = run_evaluate(cfg, args)
+    print(json.dumps(out, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

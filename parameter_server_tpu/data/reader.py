@@ -55,10 +55,9 @@ class MinibatchReader:
         if backend == "native" and not _native.native_available():
             raise RuntimeError("native parser requested but not available")
 
-    def _rows(self) -> Iterator:
-        for _ in range(self.epochs):
-            for f in self.files:
-                yield from iter_format(self.fmt, f)
+    def _epoch_rows(self) -> Iterator:
+        for f in self.files:
+            yield from iter_format(self.fmt, f)
 
     def _flat_batches(self) -> Iterator[CSRBatch]:
         """Native path: C++ chunk parse -> vectorized batch slicing."""
@@ -110,8 +109,8 @@ class MinibatchReader:
                 np.concatenate([oa, ob]),
             )
 
-        leftover = None
         for _ in range(self.epochs):
+            leftover = None
             for f in self.files:
                 for flat in iter_chunks(f, self.fmt):
                     merged = cat(leftover, flat) if leftover is not None else flat
@@ -122,33 +121,35 @@ class MinibatchReader:
                         except StopIteration as s:
                             leftover = s.value
                             break
-        if leftover is not None and len(leftover[0]) and not self.drop_remainder:
-            yield self.builder.build_flat(*leftover)
+            # epoch boundary flushes (epochs=N == N runs of epochs=1)
+            if leftover is not None and len(leftover[0]) and not self.drop_remainder:
+                yield self.builder.build_flat(*leftover)
 
     def _batches(self) -> Iterator[CSRBatch]:
         if self.use_native:
             yield from self._flat_batches()
             return
-        labels: list[float] = []
-        keys: list[np.ndarray] = []
-        vals: list[np.ndarray] = []
-        slots: list[np.ndarray] = []
-        nnz = 0
-        for label, k, v, s in self._rows():
-            # flush if the next row would overflow either capacity
-            if labels and (
-                len(labels) == self.builder.batch_size
-                or nnz + len(k) > self.builder.nnz_capacity
-            ):
+        for _ in range(self.epochs):
+            labels: list[float] = []
+            keys: list[np.ndarray] = []
+            vals: list[np.ndarray] = []
+            slots: list[np.ndarray] = []
+            nnz = 0
+            for label, k, v, s in self._epoch_rows():
+                # flush if the next row would overflow either capacity
+                if labels and (
+                    len(labels) == self.builder.batch_size
+                    or nnz + len(k) > self.builder.nnz_capacity
+                ):
+                    yield self.builder.build(np.array(labels), keys, vals, slots)
+                    labels, keys, vals, slots, nnz = [], [], [], [], 0
+                labels.append(label)
+                keys.append(k)
+                vals.append(v)
+                slots.append(s)
+                nnz += len(k)
+            if labels and not self.drop_remainder:
                 yield self.builder.build(np.array(labels), keys, vals, slots)
-                labels, keys, vals, slots, nnz = [], [], [], [], 0
-            labels.append(label)
-            keys.append(k)
-            vals.append(v)
-            slots.append(s)
-            nnz += len(k)
-        if labels and not self.drop_remainder:
-            yield self.builder.build(np.array(labels), keys, vals, slots)
 
     def __iter__(self) -> Iterator[CSRBatch]:
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)

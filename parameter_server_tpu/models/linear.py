@@ -168,14 +168,16 @@ class LinearMethod:
             last = _flush()
         return last
 
-    def train_files(self, files: list[str], key_mode: str = "hash") -> dict[str, Any]:
+    def train_files(
+        self, files: list[str], key_mode: str = "hash", report_every: int = 50
+    ) -> dict[str, Any]:
         reader = MinibatchReader(
             files,
             self.cfg.data.format,
             self.make_builder(key_mode),
             epochs=self.cfg.solver.epochs,
         )
-        return self.train(reader)
+        return self.train(reader, report_every=report_every)
 
     def predict(self, batches: Iterable[CSRBatch]) -> tuple[np.ndarray, np.ndarray]:
         """Returns (labels, probs) over the stream."""
@@ -190,3 +192,41 @@ class LinearMethod:
         """Batch evaluation (reference analog: model_evaluation app)."""
         y, p = self.predict(batches)
         return {"auc": M.auc(y, p), "logloss": M.logloss(y, p), "examples": len(y)}
+
+    def save(self, ckpt_dir: str) -> None:
+        """Sharded checkpoint of the KV state + training cursor (reference:
+        per-server SaveModel of its key range + recovery metadata)."""
+        from parameter_server_tpu.utils.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            ckpt_dir,
+            {"kv": {k: np.asarray(v) for k, v in self.store.state.items()}},
+            meta={
+                "examples_seen": self.examples_seen,
+                "algo": self.cfg.solver.algo,
+                "num_keys": self.cfg.data.num_keys,
+            },
+        )
+
+    def load(self, ckpt_dir: str) -> None:
+        from parameter_server_tpu.utils.checkpoint import load_checkpoint
+
+        state, meta = load_checkpoint(ckpt_dir)
+        if meta.get("num_keys") != self.cfg.data.num_keys:
+            raise ValueError(
+                f"checkpoint num_keys {meta.get('num_keys')} != config "
+                f"{self.cfg.data.num_keys}"
+            )
+        if meta.get("algo") != self.cfg.solver.algo:
+            raise ValueError(
+                f"checkpoint algo {meta.get('algo')!r} != config "
+                f"{self.cfg.solver.algo!r}: updater state is not transferable"
+            )
+        self.store.state = {k: jnp.asarray(v) for k, v in state["kv"].items()}
+        self.examples_seen = int(meta.get("examples_seen", 0))
+
+    def dump_model(self, path: str) -> int:
+        """Reference-style text dump of nonzero weights (key\\tweight)."""
+        from parameter_server_tpu.utils.checkpoint import dump_weights_text
+
+        return dump_weights_text(np.asarray(self.store.weights())[:, 0], path)

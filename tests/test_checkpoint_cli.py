@@ -1,0 +1,266 @@
+"""Checkpoint/resume, model dump/eval, and CLI tests.
+
+Reference test analog: SaveModel/LoadModel round trips + the local.sh
+launcher driving a full train->dump->evaluate cycle."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data.batch import BatchBuilder
+from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
+from parameter_server_tpu.models.evaluation import evaluate_model
+from parameter_server_tpu.models.linear import LinearMethod
+from parameter_server_tpu.utils.checkpoint import (
+    dump_weights_text,
+    load_checkpoint,
+    load_weights_text,
+    save_checkpoint,
+)
+from parameter_server_tpu.utils.config import PSConfig
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+
+def quiet():
+    return ProgressReporter(print_fn=lambda *_: None)
+
+
+@pytest.fixture(scope="module")
+def svm_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data")
+    labels, keys, vals, _ = make_sparse_logistic(
+        2000, 500, nnz_per_example=10, noise=0.3, seed=9
+    )
+    tr, te = d / "train.svm", d / "test.svm"
+    write_libsvm(tr, labels[:1600], keys[:1600], vals[:1600])
+    write_libsvm(te, labels[1600:], keys[1600:], vals[1600:])
+    return str(tr), str(te)
+
+
+def make_cfg(train_file):
+    cfg = PSConfig()
+    cfg.data.num_keys = 1 << 12
+    cfg.data.files = [train_file]
+    cfg.solver.minibatch = 256
+    cfg.penalty.lambda_l1 = 0.05
+    return cfg
+
+
+class TestCheckpoint:
+    def test_state_roundtrip_nested(self, tmp_path):
+        state = {"kv": {"z": np.arange(6).reshape(3, 2), "n": np.ones((3, 2))}}
+        save_checkpoint(tmp_path / "ck", state, meta={"step": 7})
+        loaded, meta = load_checkpoint(tmp_path / "ck")
+        assert meta["step"] == 7
+        np.testing.assert_array_equal(loaded["kv"]["z"], state["kv"]["z"])
+
+    def test_sharded_concat(self, tmp_path):
+        d = tmp_path / "ck"
+        save_checkpoint(d, {"w": np.arange(4)}, shard_id=0, num_shards=2)
+        save_checkpoint(d, {"w": np.arange(4, 8)}, shard_id=1, num_shards=2)
+        loaded, _ = load_checkpoint(d)
+        np.testing.assert_array_equal(loaded["w"], np.arange(8))
+        one, _ = load_checkpoint(d, shard_id=1)
+        np.testing.assert_array_equal(one["w"], np.arange(4, 8))
+
+    def test_weights_text_roundtrip(self, tmp_path):
+        w = np.zeros(100, dtype=np.float32)
+        w[[3, 50, 99]] = [1.5, -2.25, 1e-7]
+        p = tmp_path / "m.txt"
+        n = dump_weights_text(w, p)
+        assert n == 3
+        w2 = load_weights_text(p, 100)
+        np.testing.assert_allclose(w2, w, rtol=1e-6)
+
+    def test_weights_text_key_overflow(self, tmp_path):
+        p = tmp_path / "m.txt"
+        p.write_text("150\t1.0\n")
+        with pytest.raises(ValueError, match="outside"):
+            load_weights_text(p, 100)
+        p.write_text("-3\t1.0\n")
+        with pytest.raises(ValueError, match="outside"):
+            load_weights_text(p, 100)
+
+    def test_train_resume_equals_uninterrupted(self, svm_files):
+        """Kill-and-resume must reproduce the uninterrupted trajectory
+        (FTRL is deterministic)."""
+        tr, _ = svm_files
+        import tempfile
+
+        # uninterrupted: 2 epochs
+        cfg = make_cfg(tr)
+        cfg.solver.epochs = 2
+        a = LinearMethod(cfg, reporter=quiet())
+        a.train_files([tr])
+
+        # interrupted: 1 epoch, checkpoint, new process-sim, resume 1 epoch
+        cfg1 = make_cfg(tr)
+        b = LinearMethod(cfg1, reporter=quiet())
+        b.train_files([tr])
+        with tempfile.TemporaryDirectory() as d:
+            b.save(d)
+            c = LinearMethod(make_cfg(tr), reporter=quiet())
+            c.load(d)
+            c.train_files([tr])
+        for k in a.store.state:
+            np.testing.assert_allclose(
+                np.asarray(a.store.state[k]),
+                np.asarray(c.store.state[k]),
+                atol=1e-6,
+                err_msg=k,
+            )
+        assert c.examples_seen == a.examples_seen
+
+    def test_load_rejects_mismatched_keyspace(self, svm_files, tmp_path):
+        tr, _ = svm_files
+        app = LinearMethod(make_cfg(tr), reporter=quiet())
+        app.save(tmp_path / "ck")
+        cfg2 = make_cfg(tr)
+        cfg2.data.num_keys = 1 << 10
+        other = LinearMethod(cfg2, reporter=quiet())
+        with pytest.raises(ValueError, match="num_keys"):
+            other.load(tmp_path / "ck")
+
+    def test_load_rejects_mismatched_algo(self, svm_files, tmp_path):
+        tr, _ = svm_files
+        app = LinearMethod(make_cfg(tr), reporter=quiet())
+        app.save(tmp_path / "ck")
+        cfg2 = make_cfg(tr)
+        cfg2.solver.algo = "sgd"
+        other = LinearMethod(cfg2, reporter=quiet())
+        with pytest.raises(ValueError, match="algo"):
+            other.load(tmp_path / "ck")
+
+
+class TestModelEvaluation:
+    def test_dump_then_evaluate(self, svm_files, tmp_path):
+        tr, te = svm_files
+        cfg = make_cfg(tr)
+        cfg.solver.epochs = 3
+        app = LinearMethod(cfg, reporter=quiet())
+        app.train_files([tr])
+        mp = tmp_path / "model.txt"
+        n = app.dump_model(str(mp))
+        assert n > 0
+        res = evaluate_model(str(mp), [te], "libsvm", cfg.data.num_keys)
+        assert res["examples"] == 400
+        assert res["auc"] > 0.8
+        # evaluating through the app gives the same result
+        from parameter_server_tpu.data.reader import MinibatchReader
+
+        direct = app.evaluate(
+            MinibatchReader([te], "libsvm", app.make_builder())
+        )
+        assert res["auc"] == pytest.approx(direct["auc"], abs=1e-6)
+
+
+class TestCLI:
+    def _run(self, *argv):
+        import os
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, "-m", "parameter_server_tpu.cli", *argv],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+        )
+        return r
+
+    def test_train_dump_evaluate_cycle(self, svm_files, tmp_path):
+        tr, te = svm_files
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text(
+            json.dumps(
+                {
+                    "data": {
+                        "files": [tr],
+                        "val_files": [te],
+                        "num_keys": 4096,
+                    },
+                    "solver": {"minibatch": 256, "epochs": 2},
+                    "penalty": {"lambda_l1": 0.05},
+                }
+            )
+        )
+        model = tmp_path / "model.txt"
+        r = self._run(
+            "train", "--app_file", str(cfg_path), "--model_out", str(model)
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["val_auc"] > 0.8
+        assert model.exists()
+
+        r2 = self._run(
+            "evaluate", "--app_file", str(cfg_path), "--model", str(model)
+        )
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        out2 = json.loads(r2.stdout.strip().splitlines()[-1])
+        assert out2["auc"] == pytest.approx(out["val_auc"], abs=1e-6)
+
+    def test_cli_darlin(self, svm_files, tmp_path):
+        tr, _ = svm_files
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text(
+            json.dumps(
+                {
+                    "data": {"files": [tr], "num_keys": 4096},
+                    "solver": {
+                        "algo": "darlin",
+                        "minibatch": 512,
+                        "feature_blocks": 8,
+                        "block_iters": 5,
+                    },
+                    "penalty": {"lambda_l1": 1.0},
+                    "lr": {"eta": 1.0},
+                }
+            )
+        )
+        r = self._run("train", "--app_file", str(cfg_path))
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["train_auc"] > 0.7
+
+    def test_cli_darlin_resume_rejected_and_val_eval(self, svm_files, tmp_path):
+        tr, te = svm_files
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text(
+            json.dumps(
+                {
+                    "data": {"files": [tr], "val_files": [te], "num_keys": 4096},
+                    "solver": {
+                        "algo": "darlin",
+                        "minibatch": 512,
+                        "feature_blocks": 8,
+                        "block_iters": 4,
+                    },
+                    "penalty": {"lambda_l1": 1.0},
+                    "lr": {"eta": 1.0},
+                }
+            )
+        )
+        r = self._run(
+            "train", "--app_file", str(cfg_path), "--resume", "--ckpt_dir", str(tmp_path / "x")
+        )
+        assert r.returncode != 0 and "not supported" in r.stderr
+        r2 = self._run(
+            "train", "--app_file", str(cfg_path), "--ckpt_dir", str(tmp_path / "ck")
+        )
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        out = json.loads(r2.stdout.strip().splitlines()[-1])
+        assert "val_auc" in out
+        assert (tmp_path / "ck" / "manifest.json").exists()
+
+    def test_cli_missing_files_errors(self, tmp_path):
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text("{}")
+        r = self._run("train", "--app_file", str(cfg_path))
+        assert r.returncode != 0
+        assert "data.files is empty" in r.stderr
