@@ -1,0 +1,18 @@
+"""Bandwidth filters (reference analog: src/filter/).
+
+The reference's filter pipeline (key caching, snappy compression,
+fixed-point float truncation) exists because ZeroMQ point-to-point traffic
+is its scarce resource. On a TPU pod:
+
+- **key caching** survives as the data layer's static batch layouts: the
+  unique-key plan of a batch is device-resident and reused; no keys move
+  per step at all on the ICI path.
+- **compression / fixed-point** matter again on the **DCN** (cross-slice)
+  path: quantized gradient collectives. ``FixedPointCodec`` is that codec,
+  with the reference's randomized (unbiased) rounding.
+- snappy-style byte compression has no collective analog; omitted by
+  design (recorded in PARITY.md).
+"""
+
+from parameter_server_tpu.filters.fixed_point import FixedPointCodec  # noqa: F401
+from parameter_server_tpu.filters.frequency import CountMinSketch  # noqa: F401

@@ -1,0 +1,158 @@
+"""Pallas TPU kernels for the framework's hot elementwise paths.
+
+Two kernels (reference analogs: the server's FTRLEntry update loop — HOT
+LOOP #2 of the async-SGD path — and filter/fixing_float.h's randomized
+rounding):
+
+- ``ftrl_delta``: the fused FTRL-proximal delta over gathered rows.
+  One VMEM pass computes w(z, n), sigma, and both deltas — no f32
+  intermediates spill to HBM between the ~10 elementwise ops.
+- ``quantize_stochastic``: int8/int16 fixed-point quantization with
+  hardware-PRNG stochastic rounding (the DCN codec's device path).
+
+Both fall back to the jnp implementations off-TPU (CPU tests run the
+fallback; TPU runs the kernels — bench.py compares them).
+
+Layout note: tables are (rows, vdim); kernels flatten to (M, 128) lanes and
+pad the tail, because the VPU wants a 128-wide last dimension and vdim is
+often 1 (sparse LR) — tiling over rows alone would waste 127/128 lanes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_LANES = 128
+_SUBLANES = 8
+
+
+def _pad_to_tiles(x: jax.Array) -> tuple[jax.Array, int]:
+    """Flatten to 1-D and pad so it reshapes to (M, 128) with M % 8 == 0."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    tile = _LANES * _SUBLANES
+    padded = (n + tile - 1) // tile * tile
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+    return flat.reshape(-1, _LANES), n
+
+
+def _unpad(mat: jax.Array, n: int, shape) -> jax.Array:
+    return mat.reshape(-1)[:n].reshape(shape)
+
+
+def _ftrl_delta_kernel(z_ref, n_ref, g_ref, dz_ref, dn_ref, *, alpha, beta, l1, l2):
+    z = z_ref[:]
+    n = n_ref[:]
+    g = g_ref[:]
+    # lazy weight w(z, n)
+    shrunk = jnp.sign(z) * jnp.maximum(jnp.abs(z) - l1, 0.0)
+    denom = (beta + jnp.sqrt(n)) / alpha + l2
+    w = -shrunk / denom
+    g2 = g * g
+    sigma = (jnp.sqrt(n + g2) - jnp.sqrt(n)) / alpha
+    dz_ref[:] = g - sigma * w
+    dn_ref[:] = g2
+
+
+@functools.partial(jax.jit, static_argnames=("alpha", "beta", "l1", "l2"))
+def ftrl_delta_pallas(
+    z: jax.Array,
+    n: jax.Array,
+    g: jax.Array,
+    *,
+    alpha: float,
+    beta: float,
+    l1: float,
+    l2: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused FTRL delta (dz, dn) over row slices of any shape."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    zm, count = _pad_to_tiles(z)
+    nm, _ = _pad_to_tiles(n)
+    gm, _ = _pad_to_tiles(g)
+    kernel = functools.partial(
+        _ftrl_delta_kernel, alpha=alpha, beta=beta, l1=l1, l2=l2
+    )
+    dz, dn = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(zm.shape, zm.dtype),
+            jax.ShapeDtypeStruct(nm.shape, nm.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+    )(zm, nm, gm)
+    return _unpad(dz, count, z.shape), _unpad(dn, count, n.shape)
+
+
+def _quantize_kernel(seed_ref, params_ref, x_ref, q_ref, *, levels):
+    from jax.experimental.pallas import tpu as pltpu
+
+    pltpu.prng_seed(seed_ref[0])
+    lo = params_ref[0]
+    scale = params_ref[1]
+    t = (x_ref[:] - lo) / scale  # in [0, levels]
+    floor = jnp.floor(t)
+    frac = t - floor
+    bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
+    # uniform in [0, 1) from the top 24 bits (fits in int32, which Mosaic
+    # can cast to float32; a direct uint32->float32 cast is unsupported)
+    top24 = pltpu.bitcast(bits >> jnp.uint32(8), jnp.int32)
+    u = top24.astype(jnp.float32) * (1.0 / (1 << 24))
+    q = floor + (u < frac).astype(jnp.float32)
+    q_ref[:] = (q - levels // 2).astype(q_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bytes",))
+def quantize_stochastic_pallas(
+    seed: jax.Array, x: jax.Array, num_bytes: int = 1
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Device-side fixed-point encode: (q, lo, scale). Hardware PRNG does
+    the unbiased rounding (ref: fixing_float randomized rounding). The
+    min/max reduction happens outside the kernel (on the unpadded array,
+    fused by XLA); the kernel does the bandwidth-heavy rounding pass."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    levels = (1 << (8 * num_bytes)) - 1
+    dtype = jnp.int8 if num_bytes == 1 else jnp.int16
+    lo = jnp.min(x).astype(jnp.float32)
+    hi = jnp.max(x).astype(jnp.float32)
+    scale = jnp.maximum(hi - lo, 1e-30) / levels
+    xm, count = _pad_to_tiles(x)
+    kernel = functools.partial(_quantize_kernel, levels=levels)
+    q = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(xm.shape, dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(
+        jnp.asarray([seed], dtype=jnp.int32),
+        jnp.stack([lo, scale]),
+        xm,
+    )
+    return _unpad(q, count, x.shape), lo, scale
+
+
+def tpu_available() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False

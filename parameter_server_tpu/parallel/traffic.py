@@ -1,0 +1,50 @@
+"""Static collective-traffic accounting.
+
+Reference analog: Postoffice counts bytes sent/received per filter stage
+and the scheduler reports traffic savings. On a pod, per-step collective
+sizes are statically computable from the program — this module is that
+accounting, used by progress reports and perf work."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class StepTraffic:
+    """Estimated bytes moved by ONE SPMD train step (per device)."""
+
+    pull_bytes: int  # psum over kv of pulled rows
+    push_bytes: int  # all_gather of (idx, grads) over data
+    total_bytes: int
+
+
+def linear_step_traffic(
+    unique_capacity: int,
+    vdim: int,
+    data_shards: int,
+    kv_shards: int,
+    value_bytes: int = 4,
+    index_bytes: int = 4,
+) -> StepTraffic:
+    """Traffic of the sparse-LR SPMD step (parallel.spmd).
+
+    pull: psum over 'kv' of a (U, vdim) float array — ring all-reduce moves
+    ~2 * (S-1)/S of the array per device.
+    push: all_gather over 'data' of (U,) indices + (U, vdim) grads — ring
+    gather moves (D-1)/D of the full gathered size per device."""
+    u = unique_capacity
+    pull = 0
+    if kv_shards > 1:
+        pull = int(2 * (kv_shards - 1) / kv_shards * u * vdim * value_bytes)
+    push = 0
+    if data_shards > 1:
+        full = data_shards * u * (index_bytes + vdim * value_bytes)
+        push = int((data_shards - 1) / data_shards * full)
+    return StepTraffic(pull, push, pull + push)
+
+
+def quantization_savings(num_bytes: int, value_bytes: int = 4) -> float:
+    """Fraction of push payload saved by the fixed-point codec on DCN
+    (ref: the filter savings report)."""
+    return 1.0 - num_bytes / value_bytes

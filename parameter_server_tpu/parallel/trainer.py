@@ -1,0 +1,234 @@
+"""PodTrainer: the multi-worker training driver.
+
+Reference analog: the whole runtime stack working together — scheduler
+assigns file shards (WorkloadPool), M workers stream minibatches and
+Push/Pull against N servers (the SPMD step over the data x kv mesh),
+bounded-delay consistency (SSPClock), merged Progress at the scheduler
+(ProgressReporter), heartbeats.
+
+SSP on a pod, concretely: collectives make each *global* step synchronous
+across the mesh, so per-worker staleness lives in two places —
+  1. within a step, every worker's gradient is computed against step-start
+     weights and pushes land sequentially (parallel.spmd), and
+  2. across steps, the host DISPATCHES up to ``max_delay + 1`` steps before
+     blocking on completed results (JAX async dispatch gives the overlap,
+     the SSPClock bounds the run-ahead — the Executor wait_time analog).
+max_delay = 0 is BSP-with-pipelining-of-one; larger values overlap more
+host batch-prep with device compute."""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from collections.abc import Iterator
+
+import jax
+import numpy as np
+
+from parameter_server_tpu.data.batch import BatchBuilder, CSRBatch
+from parameter_server_tpu.data.reader import MinibatchReader
+from parameter_server_tpu.models import metrics as M
+from parameter_server_tpu.models.linear import updater_from_config
+from parameter_server_tpu.parallel.mesh import make_mesh
+from parameter_server_tpu.parallel.spmd import (
+    make_spmd_predict_step,
+    make_spmd_train_step,
+    shard_state,
+    stack_batches,
+)
+from parameter_server_tpu.parallel.ssp import SSPClock
+from parameter_server_tpu.parallel.workload import WorkloadPool
+from parameter_server_tpu.utils.config import PSConfig
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+
+class _WorkerStream:
+    """One logical worker's batch source: drains workloads (files) from the
+    pool, reading each through a MinibatchReader (ref: SGD workers asking
+    the scheduler for the next file shard)."""
+
+    def __init__(
+        self, worker_id: int, pool: WorkloadPool, fmt: str, builder: BatchBuilder,
+        backend: str = "auto",
+    ):
+        self.worker_id = worker_id
+        self.pool = pool
+        self.fmt = fmt
+        self.builder = builder
+        self.backend = backend
+        self._iter: Iterator[CSRBatch] | None = None
+        self._current: str | None = None
+
+    def next_batch(self) -> CSRBatch | None:
+        while True:
+            if self._iter is not None:
+                b = next(self._iter, None)
+                if b is not None:
+                    return b
+                if self._current is not None:
+                    self.pool.finish(self._current)
+                self._iter = None
+                self._current = None
+            w = self.pool.fetch(self.worker_id)
+            if w is None:
+                return None
+            self._current = w
+            self._iter = iter(
+                MinibatchReader([w], self.fmt, self.builder, backend=self.backend)
+            )
+
+    def _empty(self) -> CSRBatch:
+        """Inert batch (all padding) for a drained worker: contributes no
+        loss, no gradient."""
+        return self.builder.build(np.zeros(0, dtype=np.float32), [], [])
+
+
+class PodTrainer:
+    """Train the flagship sparse-LR app across a data x kv device mesh."""
+
+    def __init__(
+        self,
+        cfg: PSConfig,
+        mesh=None,
+        reporter: ProgressReporter | None = None,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh or make_mesh(
+            cfg.parallel.data_shards, cfg.parallel.kv_shards
+        )
+        self.data_shards = self.mesh.shape["data"]
+        self.updater = updater_from_config(cfg)
+        self.step_fn = make_spmd_train_step(
+            self.updater, self.mesh, cfg.data.num_keys
+        )
+        self.predict_fn = make_spmd_predict_step(
+            self.updater, self.mesh, cfg.data.num_keys
+        )
+        self.state = shard_state(
+            self.updater.init(cfg.data.num_keys, 1), self.mesh
+        )
+        self.reporter = reporter or ProgressReporter()
+        self.clock = SSPClock(
+            num_workers=1, max_delay=max(cfg.solver.max_delay, 0)
+        )
+        self.examples_seen = 0
+
+    def _builder(self, key_mode: str) -> BatchBuilder:
+        return BatchBuilder(
+            num_keys=self.cfg.data.num_keys,
+            batch_size=self.cfg.solver.minibatch,
+            max_nnz_per_example=self.cfg.data.max_nnz_per_example,
+            key_mode=key_mode,
+        )
+
+    def train_files(
+        self,
+        files: list[str],
+        key_mode: str = "hash",
+        report_every: int = 20,
+    ) -> dict:
+        """Run all epochs over ``files`` sharded across workers."""
+        cfg = self.cfg
+        last: dict = {}
+        for _ in range(max(1, cfg.solver.epochs)):
+            pool = WorkloadPool(list(files))
+            streams = [
+                _WorkerStream(w, pool, cfg.data.format, self._builder(key_mode))
+                for w in range(self.data_shards)
+            ]
+            last = self._train_epoch(streams, report_every) or last
+        return last
+
+    def _train_epoch(self, streams: list[_WorkerStream], report_every: int) -> dict:
+        in_flight: deque = deque()  # (step_idx, loss_arr, probs_arr, labels, n)
+        window: list = []
+        n_since = 0
+        t0 = time.perf_counter()
+        step_idx = 0
+        last: dict = {}
+
+        def _retire(entry) -> None:
+            nonlocal n_since
+            _, loss_arr, probs, labels, n = entry
+            jax.block_until_ready(loss_arr)
+            self.clock.finish(0, entry[0])
+            window.append((float(loss_arr), np.asarray(probs), labels))
+
+        while True:
+            batches = [s.next_batch() for s in streams]
+            live = [b for b in batches if b is not None]
+            if not live:
+                break
+            batches = [
+                b if b is not None else streams[i]._empty()
+                for i, b in enumerate(batches)
+            ]
+            # SSP gate: block until step (t - tau - 1) has fully completed
+            target = step_idx - self.clock.max_delay - 1
+            while in_flight and in_flight[0][0] <= target:
+                _retire(in_flight.popleft())
+
+            stacked = stack_batches(batches, self.mesh)
+            self.state, out = self.step_fn(self.state, stacked)
+            n = sum(b.num_examples for b in batches)
+            self.examples_seen += n
+            n_since += n
+            labels = np.concatenate(
+                [b.labels[: b.num_examples] for b in batches]
+            )
+            mask_counts = [b.num_examples for b in batches]
+            in_flight.append(
+                (step_idx, out["loss_sum"], out["probs"], (labels, mask_counts), n)
+            )
+            step_idx += 1
+            if step_idx % report_every == 0:
+                while in_flight:
+                    _retire(in_flight.popleft())
+                last = self._flush(window, n_since, t0)
+                window, n_since, t0 = [], 0, time.perf_counter()
+        while in_flight:
+            _retire(in_flight.popleft())
+        if n_since:
+            last = self._flush(window, n_since, t0)
+        return last
+
+    def _flush(self, window, n_since: int, t0: float) -> dict:
+        losses = sum(w[0] for w in window)
+        ys, ps = [], []
+        for _, probs, (labels, counts) in window:
+            off = 0
+            for d, c in enumerate(counts):
+                ps.append(probs[d, :c])
+            ys.append(labels)
+        y = np.concatenate(ys) if ys else np.zeros(0)
+        p = np.concatenate(ps) if ps else np.zeros(0)
+        return self.reporter.report(
+            examples=self.examples_seen,
+            objv=losses / max(n_since, 1),
+            auc=M.auc(y, p) if len(y) else float("nan"),
+            ex_per_sec=n_since / max(time.perf_counter() - t0, 1e-9),
+            ssp=self.clock.progress(),
+        )
+
+    def evaluate_files(self, files: list[str], key_mode: str = "hash") -> dict:
+        """Pod-wide batch evaluation using the predict step on shard 0's
+        stream layout (eval is read-only; one worker suffices)."""
+        builder = self._builder(key_mode)
+        reader = MinibatchReader(files, self.cfg.data.format, builder)
+        ys, ps = [], []
+        for b in reader:
+            batches = [b] + [
+                _pad_like(builder) for _ in range(self.data_shards - 1)
+            ]
+            probs = np.asarray(
+                self.predict_fn(self.state, stack_batches(batches, self.mesh))
+            )
+            ps.append(probs[0, : b.num_examples])
+            ys.append(b.labels[: b.num_examples])
+        y = np.concatenate(ys)
+        p = np.concatenate(ps)
+        return {"auc": M.auc(y, p), "logloss": M.logloss(y, p), "examples": len(y)}
+
+
+def _pad_like(builder: BatchBuilder) -> CSRBatch:
+    return builder.build(np.zeros(0, dtype=np.float32), [], [])

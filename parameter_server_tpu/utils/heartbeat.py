@@ -1,0 +1,111 @@
+"""Heartbeats and failure detection.
+
+Reference analog: node->scheduler heartbeats carrying CPU/mem/net stats
+(system/heartbeat_info.h), the scheduler dashboard table, and dead-node
+detection from missed heartbeats / transport disconnects.
+
+Here hosts are processes in a pod: each runs a ``HeartbeatReporter`` thread
+publishing stats into a shared ``HeartbeatMonitor`` (in-process for tests /
+single host; multi-host transports can publish the same dicts through the
+jax.distributed KV store). The monitor flags nodes whose last beat is older
+than a timeout — the trigger for checkpoint-restart recovery."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+def host_stats() -> dict:
+    """CPU/mem snapshot for this process (ref: heartbeat_info fields)."""
+    out: dict = {"pid": os.getpid(), "time": time.time()}
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        out["max_rss_mb"] = ru.ru_maxrss / 1024.0
+        out["utime_s"] = ru.ru_utime
+        out["stime_s"] = ru.ru_stime
+    except Exception:  # pragma: no cover - platform-specific
+        pass
+    try:
+        out["load1"] = os.getloadavg()[0]
+    except OSError:  # pragma: no cover
+        pass
+    return out
+
+
+class HeartbeatMonitor:
+    """Scheduler-side registry of last-seen beats (thread-safe)."""
+
+    def __init__(self, timeout_s: float = 30.0):
+        self.timeout_s = timeout_s
+        self._beats: dict[int, dict] = {}
+        self._lock = threading.Lock()
+
+    def beat(self, node_id: int, stats: dict | None = None) -> None:
+        with self._lock:
+            self._beats[node_id] = {
+                "t": time.monotonic(),
+                "stats": stats or {},
+            }
+
+    def alive(self) -> list[int]:
+        now = time.monotonic()
+        with self._lock:
+            return sorted(
+                n for n, b in self._beats.items() if now - b["t"] <= self.timeout_s
+            )
+
+    def dead(self) -> list[int]:
+        """Nodes that have beaten before but are now overdue (ref: the
+        dead-node list driving recovery)."""
+        now = time.monotonic()
+        with self._lock:
+            return sorted(
+                n for n, b in self._beats.items() if now - b["t"] > self.timeout_s
+            )
+
+    def dashboard(self) -> str:
+        """The scheduler's cluster table (ref: dashboard printout)."""
+        now = time.monotonic()
+        with self._lock:
+            lines = [f"{'node':>6} {'age_s':>8} {'rss_mb':>8} {'load1':>6}"]
+            for n in sorted(self._beats):
+                b = self._beats[n]
+                s = b["stats"]
+                lines.append(
+                    f"{n:>6} {now - b['t']:>8.1f} "
+                    f"{s.get('max_rss_mb', float('nan')):>8.1f} "
+                    f"{s.get('load1', float('nan')):>6.2f}"
+                )
+        return "\n".join(lines)
+
+
+class HeartbeatReporter:
+    """Per-node thread beating into a monitor every ``interval_s``."""
+
+    def __init__(
+        self, monitor: HeartbeatMonitor, node_id: int, interval_s: float = 5.0
+    ):
+        self.monitor = monitor
+        self.node_id = node_id
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "HeartbeatReporter":
+        self.monitor.beat(self.node_id, host_stats())  # immediate first beat
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.monitor.beat(self.node_id, host_stats())
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
