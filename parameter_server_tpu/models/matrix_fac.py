@@ -90,6 +90,27 @@ def batch_to_device(b: MFBatch) -> dict[str, jax.Array]:
     }
 
 
+def _mf_loss_and_grads(
+    U: jax.Array, V: jax.Array, batch: dict[str, jax.Array], l2: float
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared SSE loss + per-unique-key factor gradients (single-device and
+    SPMD paths both use this; pad slot 0 is excluded from L2)."""
+    u = jnp.take(U, batch["user_ids"], axis=0)  # (B, r)
+    v = jnp.take(V, batch["item_ids"], axis=0)
+    pred = jnp.sum(u * v, axis=1)
+    err = (pred - batch["ratings"]) * batch["mask"]
+    loss = jnp.sum(err * err)
+    uu, ui = U.shape[0], V.shape[0]
+    # d/du = err * v (+ l2 u), aggregated over duplicate users in the batch
+    g_u = jax.ops.segment_sum(
+        err[:, None] * v, batch["user_ids"], num_segments=uu
+    ) + l2 * U * (jnp.arange(uu) > 0)[:, None]
+    g_v = jax.ops.segment_sum(
+        err[:, None] * u, batch["item_ids"], num_segments=ui
+    ) + l2 * V * (jnp.arange(ui) > 0)[:, None]
+    return loss, g_u, g_v
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3))
 def mf_train_step(
     user_up: Updater,
@@ -106,27 +127,88 @@ def mf_train_step(
     U = user_up.weights(u_rows)  # (Uu, r)
     V = item_up.weights(i_rows)  # (Ui, r)
 
-    u = jnp.take(U, batch["user_ids"], axis=0)  # (B, r)
-    v = jnp.take(V, batch["item_ids"], axis=0)
-    pred = jnp.sum(u * v, axis=1)
-    err = (pred - batch["ratings"]) * batch["mask"]
-    loss = jnp.sum(err * err)
-
-    # d/du = err * v (+ l2 u), aggregated over duplicate users in the batch
-    gu_pairs = err[:, None] * v
-    gv_pairs = err[:, None] * u
-    g_u = jax.ops.segment_sum(
-        gu_pairs, batch["user_ids"], num_segments=uk.shape[0]
-    ) + l2 * U * (jnp.arange(uk.shape[0]) > 0)[:, None]
-    g_v = jax.ops.segment_sum(
-        gv_pairs, batch["item_ids"], num_segments=ik.shape[0]
-    ) + l2 * V * (jnp.arange(ik.shape[0]) > 0)[:, None]
+    loss, g_u, g_v = _mf_loss_and_grads(U, V, batch, l2)
 
     du = user_up.delta(u_rows, g_u)
     dv = item_up.delta(i_rows, g_v)
     new_user = {k: user_state[k].at[uk].add(du[k]) for k in user_state}
     new_item = {k: item_state[k].at[ik].add(dv[k]) for k in item_state}
     return new_user, new_item, loss
+
+
+def make_mf_spmd_train_step(
+    user_up: Updater,
+    item_up: Updater,
+    mesh,
+    num_user_rows: int,
+    num_item_rows: int,
+    l2: float,
+):
+    """Multi-device MF step: user and item factor tables range-sharded over
+    the ``kv`` mesh axis, rating batches over ``data`` (the reference's MF
+    app topology: rating blocks on workers, factors on servers)."""
+
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from parameter_server_tpu.parallel.spmd import (
+        _local_pull,
+        _local_push,
+        _shard_size,
+        batch_spec,
+        state_spec,
+    )
+
+    u_shard = _shard_size(num_user_rows, mesh.shape["kv"])
+    i_shard = _shard_size(num_item_rows, mesh.shape["kv"])
+
+    def local_step(user_l, item_l, batch):
+        b = {k: v[0] for k, v in batch.items()}
+        uk, ik = b["user_keys"], b["item_keys"]
+        U = lax.psum(_local_pull(user_up, user_l, uk, u_shard), "kv")
+        V = lax.psum(_local_pull(item_up, item_l, ik, i_shard), "kv")
+        loss, g_u, g_v = _mf_loss_and_grads(U, V, b, l2)
+        new_user = _local_push(
+            user_up, user_l, lax.all_gather(uk, "data"),
+            lax.all_gather(g_u, "data"), u_shard,
+        )
+        new_item = _local_push(
+            item_up, item_l, lax.all_gather(ik, "data"),
+            lax.all_gather(g_v, "data"), i_shard,
+        )
+        return new_user, new_item, lax.psum(loss, "data")
+
+    step = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec(), state_spec(), batch_spec()),
+        out_specs=(state_spec(), state_spec(), P()),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def jitted(user_state, item_state, batch):
+        return step(user_state, item_state, batch)
+
+    return jitted
+
+
+def stack_mf_batches(batches: list[MFBatch], mesh) -> dict[str, jax.Array]:
+    """Stack per-worker MFBatches on a leading axis, sharded over data."""
+    from jax.sharding import NamedSharding
+
+    from parameter_server_tpu.parallel.spmd import batch_spec
+
+    out = {
+        "user_keys": np.stack([b.user_keys for b in batches]),
+        "item_keys": np.stack([b.item_keys for b in batches]),
+        "user_ids": np.stack([b.user_ids for b in batches]),
+        "item_ids": np.stack([b.item_ids for b in batches]),
+        "ratings": np.stack([b.ratings for b in batches]),
+        "mask": np.stack([b.mask for b in batches]),
+    }
+    sh = NamedSharding(mesh, batch_spec())
+    return {k: jax.device_put(v, sh) for k, v in out.items()}
 
 
 class MatrixFactorization:

@@ -80,6 +80,19 @@ def _forward(w_u, emb_rows_w, mlp_params, b):
     return loss, logits
 
 
+def _wd_grads(w_u, e_w, mlp_params, b):
+    """Shared loss + grads wrt (pulled wide rows, pulled emb rows, MLP)."""
+    (loss, logits), grads = jax.value_and_grad(
+        lambda w, e, p: _forward(w, e, p, b), argnums=(0, 1, 2), has_aux=True
+    )(w_u, e_w, mlp_params)
+    return loss, logits, grads
+
+
+def _mlp_update(opt, g_mlp, opt_state, mlp_params):
+    updates, new_opt_state = opt.update(g_mlp, opt_state, mlp_params)
+    return optax.apply_updates(mlp_params, updates), new_opt_state
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(3, 4))
 def wd_train_step(
     wide_up: Updater,
@@ -97,20 +110,80 @@ def wd_train_step(
     w_u = wide_up.weights(wide_rows)
     e_w = emb_up.weights(emb_rows)
 
-    (loss, logits), grads = jax.value_and_grad(
-        lambda w, e, p: _forward(w, e, p, batch), argnums=(0, 1, 2), has_aux=True
-    )(w_u, e_w, mlp_params)
-    g_wide, g_emb, g_mlp = grads
+    loss, logits, (g_wide, g_emb, g_mlp) = _wd_grads(w_u, e_w, mlp_params, batch)
 
     d_wide = wide_up.delta(wide_rows, g_wide)
     new_wide = {k: wide_state[k].at[idx].add(d_wide[k]) for k in wide_state}
     d_emb = emb_up.delta(emb_rows, g_emb)
     new_emb = {k: emb_state[k].at[idx].add(d_emb[k]) for k in emb_state}
 
-    updates, new_opt_state = opt.update(g_mlp, opt_state, mlp_params)
-    new_mlp = optax.apply_updates(mlp_params, updates)
+    new_mlp, new_opt_state = _mlp_update(opt, g_mlp, opt_state, mlp_params)
     probs = jax.nn.sigmoid(logits)
     return new_wide, new_emb, new_mlp, new_opt_state, loss, probs
+
+
+def make_wd_spmd_train_step(
+    wide_up: Updater,
+    emb_up: Updater,
+    opt: Any,
+    mesh,
+    num_keys: int,
+):
+    """Multi-device Wide&Deep step: both KV tables range-sharded over the
+    ``kv`` mesh axis (BASELINE.json: "server-sharded embeddings"), batches
+    over ``data``; MLP params replicated with psum'd gradients.
+
+    Same wire pattern as the linear SPMD step (parallel/spmd.py): pull =
+    masked gather + psum over kv; push = all_gather over data + sequential
+    per-worker updates on each kv shard."""
+
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from parameter_server_tpu.parallel.spmd import (
+        _local_pull,
+        _local_push,
+        _shard_size,
+        batch_spec,
+        state_spec,
+    )
+
+    shard_size = _shard_size(num_keys, mesh.shape["kv"])
+
+    def local_step(wide_l, emb_l, mlp_params, opt_state, batch):
+        b = {k: v[0] for k, v in batch.items()}
+        idx = b["unique_keys"]
+        w_u = lax.psum(_local_pull(wide_up, wide_l, idx, shard_size), "kv")
+        e_u = lax.psum(_local_pull(emb_up, emb_l, idx, shard_size), "kv")
+
+        loss, logits, (g_wide, g_emb, g_mlp) = _wd_grads(w_u, e_u, mlp_params, b)
+
+        all_idx = lax.all_gather(idx, "data")
+        new_wide = _local_push(
+            wide_up, wide_l, all_idx, lax.all_gather(g_wide, "data"), shard_size
+        )
+        new_emb = _local_push(
+            emb_up, emb_l, all_idx, lax.all_gather(g_emb, "data"), shard_size
+        )
+        g_mlp = jax.tree.map(lambda g: lax.psum(g, "data"), g_mlp)
+        new_mlp, new_opt_state = _mlp_update(opt, g_mlp, opt_state, mlp_params)
+        loss_sum = lax.psum(loss, "data")
+        probs = jax.nn.sigmoid(logits)[None, :]
+        return new_wide, new_emb, new_mlp, new_opt_state, loss_sum, probs
+
+    step = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec(), state_spec(), P(), P(), batch_spec()),
+        out_specs=(state_spec(), state_spec(), P(), P(), P(), batch_spec()),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def jitted(wide_state, emb_state, mlp_params, opt_state, batch):
+        return step(wide_state, emb_state, mlp_params, opt_state, batch)
+
+    return jitted
 
 
 class WideDeep:

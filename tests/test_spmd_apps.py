@@ -1,0 +1,105 @@
+"""SPMD tests for the embedding apps (Wide&Deep, MF) on the CPU mesh:
+server-sharded embedding tables over the kv axis, batches over data."""
+
+import jax
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data.batch import BatchBuilder
+from parameter_server_tpu.models.matrix_fac import (
+    MatrixFactorization,
+    MFBatchBuilder,
+    make_mf_spmd_train_step,
+    stack_mf_batches,
+)
+from parameter_server_tpu.models.wide_deep import WideDeep, make_wd_spmd_train_step
+from parameter_server_tpu.parallel import make_mesh, shard_state, stack_batches
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+
+def quiet():
+    return ProgressReporter(print_fn=lambda *_: None)
+
+
+class TestWideDeepSPMD:
+    def _xor_batches(self, builder, n=2048, bs=256, seed=0):
+        rng = np.random.default_rng(seed)
+        a, b = rng.integers(0, 2, n), rng.integers(0, 2, n)
+        y = (a ^ b).astype(np.float32)
+        keys = [np.array([ai, 2 + bi], dtype=np.uint64) for ai, bi in zip(a, b)]
+        vals = [np.ones(2, dtype=np.float32)] * n
+        return [
+            builder.build(y[i : i + bs], keys[i : i + bs], vals[i : i + bs])
+            for i in range(0, n, bs)
+        ], y
+
+    @pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2)])
+    def test_learns_xor_on_mesh(self, mesh_shape):
+        d, k = mesh_shape
+        mesh = make_mesh(d, k)
+        app = WideDeep(num_keys=64, emb_dim=8, hidden=[16], mlp_lr=5e-3,
+                       reporter=quiet())
+        step = make_wd_spmd_train_step(
+            app.wide_up, app.emb_up, app.opt, mesh, app.num_keys
+        )
+        builder = BatchBuilder(num_keys=64, batch_size=256, key_mode="identity")
+        batches, _ = self._xor_batches(builder)
+        wide = shard_state(app.wide_state, mesh)
+        emb = shard_state(app.emb_state, mesh)
+        mlp, opt_state = app.mlp_params, app.opt_state
+        losses = []
+        for epoch in range(40):
+            for s in range(0, len(batches) - d + 1, d):
+                stacked = stack_batches(batches[s : s + d], mesh)
+                wide, emb, mlp, opt_state, loss, probs = step(
+                    wide, emb, mlp, opt_state, stacked
+                )
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.3, losses[::8]
+        # push the trained sharded state back into the app and evaluate
+        app.wide_state = {k2: jax.device_get(v) for k2, v in wide.items()}
+        app.emb_state = {k2: jax.device_get(v) for k2, v in emb.items()}
+        app.wide_state = {k2: jax.numpy.asarray(v) for k2, v in app.wide_state.items()}
+        app.emb_state = {k2: jax.numpy.asarray(v) for k2, v in app.emb_state.items()}
+        app.mlp_params = mlp
+        ev = app.evaluate(batches)
+        assert ev["auc"] > 0.9, ev
+
+
+class TestMFSPMD:
+    def test_converges_on_mesh(self):
+        mesh = make_mesh(2, 4)
+        rng = np.random.default_rng(0)
+        n_u, n_i, rank = 96, 64, 4
+        U = rng.normal(size=(n_u, rank)) / np.sqrt(rank)
+        V = rng.normal(size=(n_i, rank)) / np.sqrt(rank)
+        # ids stay in [0, n_u-1) so the max id maps to the LAST table row
+        # (key n_u-1), exercising the final kv shard's boundary
+        us = rng.integers(0, n_u - 1, 6000)
+        it = rng.integers(0, n_i - 1, 6000)
+        r = (np.sum(U[us] * V[it], 1) + 0.05 * rng.normal(size=6000)).astype(
+            np.float32
+        )
+        app = MatrixFactorization(n_u - 1, n_i - 1, rank=8, eta=0.1, l2=0.002,
+                                  reporter=quiet())
+        # row counts: num_users+1 must divide kv axis; 96/64 are multiples of 4
+        step = make_mf_spmd_train_step(
+            app.user_up, app.item_up, mesh, n_u, n_i, l2=0.002
+        )
+        user = shard_state(app.user_state, mesh)
+        item = shard_state(app.item_state, mesh)
+        builder = MFBatchBuilder(batch_size=750)
+        first = last = None
+        for epoch in range(12):
+            order = np.random.default_rng(epoch).permutation(6000)
+            for s in range(0, 6000, 1500):
+                sel = order[s : s + 1500]
+                bs = [
+                    builder.build(us[sel[i::2]], it[sel[i::2]], r[sel[i::2]])
+                    for i in range(2)
+                ]
+                user, item, loss = step(user, item, stack_mf_batches(bs, mesh))
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+        assert last < first * 0.3, (first, last)
