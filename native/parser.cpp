@@ -67,6 +67,17 @@ inline void skip_ws(const char*& p, const char* end) {
   while (p < end && (*p == ' ' || *p == '\t')) ++p;
 }
 
+// Line end for [p, buf_end): position of '\n' (or buf_end), with a trailing
+// '\r' excluded so CRLF files parse like the Python text-mode readers.
+inline const char* find_line_end(const char* p, const char* end,
+                                 const char** next_line) {
+  const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+  const char* le = nl ? nl : end;
+  *next_line = le + 1;
+  if (le > p && le[-1] == '\r') --le;
+  return le;
+}
+
 }  // namespace
 
 extern "C" {
@@ -82,11 +93,11 @@ int ps_parse_libsvm(const char* buf, int64_t len,
   int64_t rows = 0, nnz = 0, line = 0;
   row_splits[0] = 0;
   while (p < end) {
-    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
-    if (!line_end) line_end = end;
+    const char* next_line;
+    const char* line_end = find_line_end(p, end, &next_line);
     skip_ws(p, line_end);
     if (p >= line_end) {  // blank line
-      p = line_end + 1;
+      p = next_line;
       ++line;
       continue;
     }
@@ -118,7 +129,7 @@ int ps_parse_libsvm(const char* buf, int64_t len,
     }
     ++rows;
     row_splits[rows] = nnz;
-    p = line_end + 1;
+    p = next_line;
     ++line;
   }
   *out_rows = rows;
@@ -140,10 +151,10 @@ int ps_parse_criteo(const char* buf, int64_t len,
   int64_t rows = 0, nnz = 0, line = 0;
   row_splits[0] = 0;
   while (p < end) {
-    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
-    if (!line_end) line_end = end;
+    const char* next_line;
+    const char* line_end = find_line_end(p, end, &next_line);
     if (p >= line_end) {
-      p = line_end + 1;
+      p = next_line;
       ++line;
       continue;
     }
@@ -152,7 +163,7 @@ int ps_parse_criteo(const char* buf, int64_t len,
     for (const char* q = p; q < line_end; ++q)
       if (*q == '\t') ++cols;
     if (cols < 40) {
-      p = line_end + 1;
+      p = next_line;
       ++line;
       continue;
     }
@@ -196,7 +207,79 @@ int ps_parse_criteo(const char* buf, int64_t len,
     }
     ++rows;
     row_splits[rows] = nnz;
-    p = line_end + 1;
+    p = next_line;
+    ++line;
+  }
+  *out_rows = rows;
+  *out_nnz = nnz;
+  return 0;
+}
+
+// adfea: "line_id label fea:grp fea:grp ...". Pure one-hot ad features:
+// value is implicitly 1.0, the group id is the slot. Leading line id is
+// metadata and dropped WITHOUT being parsed (ids like hashes are fine,
+// matching the Python path). A token without ':' gets slot 0.
+int ps_parse_adfea(const char* buf, int64_t len,
+                   int64_t max_rows, int64_t max_nnz,
+                   float* labels, int64_t* row_splits,
+                   uint64_t* keys, float* vals, uint64_t* slots,
+                   int64_t* out_rows, int64_t* out_nnz, int64_t* err_line) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t rows = 0, nnz = 0, line = 0;
+  row_splits[0] = 0;
+  while (p < end) {
+    const char* next_line;
+    const char* line_end = find_line_end(p, end, &next_line);
+    skip_ws(p, line_end);
+    if (p >= line_end) {  // blank line
+      p = next_line;
+      ++line;
+      continue;
+    }
+    if (rows >= max_rows) return -1;
+    while (p < line_end && *p != ' ' && *p != '\t') ++p;  // drop line id token
+    skip_ws(p, line_end);
+    if (p >= line_end) {  // line id but no label: skip, like the Python path
+      p = next_line;
+      ++line;
+      continue;
+    }
+    // label must be a full float token (Python float() raises on junk)
+    const char* tok = p;
+    double y = parse_float(p, line_end);
+    if (p == tok || (p < line_end && *p != ' ' && *p != '\t')) {
+      *err_line = line;
+      return -2;
+    }
+    labels[rows] = y > 0 ? 1.0f : 0.0f;
+    while (true) {
+      skip_ws(p, line_end);
+      if (p >= line_end) break;
+      uint64_t k;
+      if (!parse_u64(p, line_end, k)) {
+        *err_line = line;
+        return -2;
+      }
+      uint64_t g = 0;
+      if (p < line_end && *p == ':') {
+        ++p;
+        // "k:" with empty group -> slot 0, like Python's `if g:` guard
+        if (p < line_end && *p != ' ' && *p != '\t' &&
+            !parse_u64(p, line_end, g)) {
+          *err_line = line;
+          return -2;
+        }
+      }
+      if (nnz >= max_nnz) return -1;
+      keys[nnz] = k;
+      vals[nnz] = 1.0f;
+      slots[nnz] = g;
+      ++nnz;
+    }
+    ++rows;
+    row_splits[rows] = nnz;
+    p = next_line;
     ++line;
   }
   *out_rows = rows;

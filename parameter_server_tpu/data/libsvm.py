@@ -90,7 +90,33 @@ def iter_criteo(path: str | Path) -> Iterator[Row]:
             )
 
 
-FORMATS = {"libsvm": iter_libsvm, "criteo": iter_criteo}
+def iter_adfea(path: str | Path) -> Iterator[Row]:
+    """Parse the adfea ad-feature format: ``line_id label fea:grp fea:grp ...``.
+
+    Ref: ParseAdfea in src/data/text_parser.cc. Each token after the line id
+    and click label is ``feature_id:group_id``; the group id is the slot
+    (feature group) and the value is implicitly 1.0 (pure one-hot ad
+    features). A token without ``:`` gets slot 0. The leading line id is
+    metadata and is dropped.
+    """
+    with _open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            label = 1.0 if float(parts[1]) > 0 else 0.0
+            n = len(parts) - 2
+            keys = np.empty(n, dtype=np.uint64)
+            slots = np.zeros(n, dtype=np.uint64)
+            for i, tok in enumerate(parts[2:]):
+                k, _, g = tok.partition(":")
+                keys[i] = int(k)
+                if g:
+                    slots[i] = int(g)
+            yield label, keys, np.ones(n, dtype=np.float32), slots
+
+
+FORMATS = {"libsvm": iter_libsvm, "criteo": iter_criteo, "adfea": iter_adfea}
 
 
 def iter_format(fmt: str, path: str | Path) -> Iterator[Row]:

@@ -25,6 +25,14 @@ _LIB_ENV = "PS_TPU_NATIVE_LIB"
 FlatRows = tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]
 # (labels (R,), row_splits (R+1,), keys (N,), vals (N,), slots (N,))
 
+# Formats with a native fast path; the single source of truth for the
+# reader's backend="auto" choice and parse_chunk dispatch.
+NATIVE_FORMATS = {
+    "libsvm": "ps_parse_libsvm",
+    "criteo": "ps_parse_criteo",
+    "adfea": "ps_parse_adfea",
+}
+
 _lib: ctypes.CDLL | None = None
 _lib_tried = False
 
@@ -64,7 +72,7 @@ def load_native() -> ctypes.CDLL | None:
         return None
     i64, u64p = ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64)
     f32p, i64p = ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64)
-    for fn in ("ps_parse_libsvm", "ps_parse_criteo"):
+    for fn in NATIVE_FORMATS.values():
         f = getattr(lib, fn)
         f.restype = ctypes.c_int
         f.argtypes = [
@@ -100,9 +108,9 @@ def parse_chunk(fmt: str, chunk: bytes, max_rows_hint: int = 0) -> FlatRows:
     out_rows = ctypes.c_int64()
     out_nnz = ctypes.c_int64()
     err_line = ctypes.c_int64(-1)
-    fn = lib.ps_parse_libsvm if fmt == "libsvm" else lib.ps_parse_criteo
-    if fmt not in ("libsvm", "criteo"):
+    if fmt not in NATIVE_FORMATS:
         raise ValueError(f"native parser: unknown format {fmt!r}")
+    fn = getattr(lib, NATIVE_FORMATS[fmt])
     rc = fn(
         chunk,
         len(chunk),

@@ -50,7 +50,9 @@ class MinibatchReader:
         from parameter_server_tpu.data import native as _native
 
         self.use_native = backend == "native" or (
-            backend == "auto" and fmt in ("libsvm", "criteo") and _native.native_available()
+            backend == "auto"
+            and fmt in _native.NATIVE_FORMATS
+            and _native.native_available()
         )
         if backend == "native" and not _native.native_available():
             raise RuntimeError("native parser requested but not available")

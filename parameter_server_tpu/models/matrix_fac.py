@@ -193,22 +193,15 @@ def make_mf_spmd_train_step(
     return jitted
 
 
-def stack_mf_batches(batches: list[MFBatch], mesh) -> dict[str, jax.Array]:
+def stack_mf_batches(batches: list[MFBatch], mesh=None) -> dict[str, jax.Array]:
     """Stack per-worker MFBatches on a leading axis, sharded over data."""
-    from jax.sharding import NamedSharding
+    from parameter_server_tpu.parallel.spmd import stack_fields
 
-    from parameter_server_tpu.parallel.spmd import batch_spec
-
-    out = {
-        "user_keys": np.stack([b.user_keys for b in batches]),
-        "item_keys": np.stack([b.item_keys for b in batches]),
-        "user_ids": np.stack([b.user_ids for b in batches]),
-        "item_ids": np.stack([b.item_ids for b in batches]),
-        "ratings": np.stack([b.ratings for b in batches]),
-        "mask": np.stack([b.mask for b in batches]),
-    }
-    sh = NamedSharding(mesh, batch_spec())
-    return {k: jax.device_put(v, sh) for k, v in out.items()}
+    return stack_fields(
+        batches,
+        ("user_keys", "item_keys", "user_ids", "item_ids", "ratings", "mask"),
+        mesh,
+    )
 
 
 class MatrixFactorization:

@@ -54,22 +54,27 @@ def shard_state(state: State, mesh: Mesh) -> State:
     return {k: jax.device_put(v, sh) for k, v in state.items()}
 
 
-def stack_batches(batches: list[CSRBatch], mesh: Mesh | None = None) -> Batch:
-    """Stack D per-worker batches on a leading axis; shard over "data"."""
+def stack_fields(
+    batches: list, fields: tuple[str, ...], mesh: Mesh | None = None
+) -> Batch:
+    """Stack the named attributes of D per-worker batches on a leading axis;
+    with a mesh, place the result sharded over the "data" axis."""
     import numpy as np
 
-    out = {
-        "unique_keys": np.stack([b.unique_keys for b in batches]),
-        "local_ids": np.stack([b.local_ids for b in batches]),
-        "row_ids": np.stack([b.row_ids for b in batches]),
-        "values": np.stack([b.values for b in batches]),
-        "labels": np.stack([b.labels for b in batches]),
-        "example_mask": np.stack([b.example_mask for b in batches]),
-    }
+    out = {f: np.stack([getattr(b, f) for b in batches]) for f in fields}
     if mesh is None:
         return {k: jnp.asarray(v) for k, v in out.items()}
     sh = NamedSharding(mesh, batch_spec())
     return {k: jax.device_put(v, sh) for k, v in out.items()}
+
+
+def stack_batches(batches: list[CSRBatch], mesh: Mesh | None = None) -> Batch:
+    """Stack D per-worker CSR batches; shard over "data"."""
+    return stack_fields(
+        batches,
+        ("unique_keys", "local_ids", "row_ids", "values", "labels", "example_mask"),
+        mesh,
+    )
 
 
 def _local_pull(

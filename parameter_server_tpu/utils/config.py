@@ -21,7 +21,7 @@ class DataConfig:
     """Ref: linear_method.proto DataConfig {format, file, ignore_feature_group}."""
 
     files: list[str] = field(default_factory=list)
-    format: str = "libsvm"  # libsvm | criteo | cache
+    format: str = "libsvm"  # libsvm | criteo | adfea | cache
     num_keys: int = 1 << 22  # dense hashed key-space size (power of two + pad row)
     val_files: list[str] = field(default_factory=list)
     max_nnz_per_example: int = 512

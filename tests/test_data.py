@@ -55,6 +55,28 @@ class TestCriteo:
         assert list(iter_criteo(p)) == []
 
 
+class TestAdfea:
+    def test_golden(self, tmp_path):
+        from parameter_server_tpu.data.libsvm import iter_adfea
+
+        p = tmp_path / "a.adfea"
+        p.write_text("10001 1 37:4 982:4 17:9\n10002 0 5:1\n")
+        rows = list(iter_adfea(p))
+        assert [r[0] for r in rows] == [1.0, 0.0]
+        np.testing.assert_array_equal(rows[0][1], [37, 982, 17])
+        np.testing.assert_array_equal(rows[0][3], [4, 4, 9])  # group ids -> slots
+        np.testing.assert_allclose(rows[0][2], 1.0)  # values implicitly 1
+
+    def test_short_and_groupless(self, tmp_path):
+        from parameter_server_tpu.data.libsvm import iter_adfea
+
+        p = tmp_path / "a.adfea"
+        p.write_text("1\n77 1 12\n")  # id-only line skipped; bare key -> slot 0
+        rows = list(iter_adfea(p))
+        assert len(rows) == 1
+        assert rows[0][1][0] == 12 and rows[0][3][0] == 0
+
+
 class TestBatchBuilder:
     def test_localizer_identity_roundtrip(self):
         b = BatchBuilder(num_keys=100, batch_size=4, key_mode="identity")

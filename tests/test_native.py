@@ -110,6 +110,52 @@ class TestCriteoParity:
         np.testing.assert_array_equal(nat[2], py[0][1])
 
 
+class TestAdfeaParity:
+    def test_parity_random(self, tmp_path):
+        from parameter_server_tpu.data.libsvm import iter_adfea
+
+        rng = np.random.default_rng(3)
+        lines = []
+        for i in range(300):
+            toks = [str(10000 + i), str(int(rng.integers(0, 2)))]
+            toks += [
+                f"{int(rng.integers(0, 2**40))}:{int(rng.integers(0, 64))}"
+                for _ in range(int(rng.integers(1, 30)))
+            ]
+            lines.append(" ".join(toks))
+        p = tmp_path / "p.adfea"
+        p.write_text("\n".join(lines) + "\n")
+        flat = native.parse_chunk("adfea", p.read_bytes())
+        assert_rows_equal(rows_from_flat(flat), list(iter_adfea(p)))
+
+    def test_edge_cases_match_python(self, tmp_path):
+        from parameter_server_tpu.data.libsvm import iter_adfea
+
+        p = tmp_path / "p.adfea"
+        # id-only line skipped; non-numeric id fine; "k:" -> slot 0; CRLF ok
+        p.write_bytes(b"5\nhash_x 1 3:2\r\n9 0 7: 8:4\n")
+        flat = native.parse_chunk("adfea", p.read_bytes())
+        assert_rows_equal(rows_from_flat(flat), list(iter_adfea(p)))
+        with pytest.raises(ValueError, match="line 0"):
+            native.parse_chunk("adfea", b"1 1 3:y\n")  # junk group id
+        with pytest.raises(ValueError, match="line 0"):
+            native.parse_chunk("adfea", b"1 zz 3:2\n")  # junk label
+
+    def test_crlf_matches_python_all_formats(self, tmp_path):
+        from parameter_server_tpu.data.libsvm import iter_criteo, iter_libsvm
+
+        svm = tmp_path / "w.svm"
+        svm.write_bytes(b"1 3:0.5 7:2\r\n-1 1:1\r\n")
+        flat = native.parse_chunk("libsvm", svm.read_bytes())
+        assert_rows_equal(rows_from_flat(flat), list(iter_libsvm(svm)))
+
+        row = "\t".join(["1"] + [str(i) for i in range(13)] + ["ff"] * 26)
+        tsv = tmp_path / "w.tsv"
+        tsv.write_bytes((row + "\r\n" + row + "\r\n").encode())
+        flat = native.parse_chunk("criteo", tsv.read_bytes())
+        assert_rows_equal(rows_from_flat(flat), list(iter_criteo(tsv)))
+
+
 class TestChunkedStreaming:
     def test_small_chunks_match_whole_file(self, tmp_path):
         labels, keys, vals, _ = make_sparse_logistic(300, 500, nnz_per_example=8)
