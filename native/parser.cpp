@@ -67,15 +67,19 @@ inline void skip_ws(const char*& p, const char* end) {
   while (p < end && (*p == ' ' || *p == '\t')) ++p;
 }
 
-// Line end for [p, buf_end): position of '\n' (or buf_end), with a trailing
-// '\r' excluded so CRLF files parse like the Python text-mode readers.
+// Line end for [p, buf_end): first '\n', '\r', or '\r\n' terminator (or
+// buf_end), universal-newlines style, so CRLF and lone-CR files parse like
+// the Python text-mode readers.
 inline const char* find_line_end(const char* p, const char* end,
                                  const char** next_line) {
   const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-  const char* le = nl ? nl : end;
-  *next_line = le + 1;
-  if (le > p && le[-1] == '\r') --le;
-  return le;
+  const char* cr = static_cast<const char*>(memchr(p, '\r', end - p));
+  if (cr && (!nl || cr < nl)) {
+    *next_line = (cr + 1 < end && cr[1] == '\n') ? cr + 2 : cr + 1;
+    return cr;
+  }
+  *next_line = nl ? nl + 1 : end + 1;
+  return nl ? nl : end;
 }
 
 }  // namespace

@@ -58,13 +58,11 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
                 "(it restarts from its cached column blocks)"
             )
         app = Darlin(cfg)
-        builder = BatchBuilder(
-            num_keys=cfg.data.num_keys,
-            batch_size=cfg.solver.minibatch,
-            max_nnz_per_example=cfg.data.max_nnz_per_example,
-        )
-        batches = list(MinibatchReader(cfg.data.files, cfg.data.format, builder))
-        res = app.fit(batches)
+        # SlotReader behavior: with data.cache_dir set, the first run parses
+        # text and writes the columnar block cache; re-runs mmap it instead.
+        from parameter_server_tpu.data.blockcache import cached_column_blocks
+
+        res = app.fit_blocks(cached_column_blocks(cfg))
         if args.ckpt_dir:
             save_checkpoint(
                 args.ckpt_dir,
@@ -75,6 +73,11 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
             dump_weights_text(app.w, args.model_out)
         out = {k: res[k] for k in ("objv", "iters", "nnz_w", "train_auc")}
         if cfg.data.val_files:
+            builder = BatchBuilder(
+                num_keys=cfg.data.num_keys,
+                batch_size=cfg.solver.minibatch,
+                max_nnz_per_example=cfg.data.max_nnz_per_example,
+            )
             val = list(
                 MinibatchReader(cfg.data.val_files, cfg.data.format, builder)
             )

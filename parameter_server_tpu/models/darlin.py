@@ -27,86 +27,18 @@ instead of message-driven.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from parameter_server_tpu.data.batch import CSRBatch
+from parameter_server_tpu.data.blockcache import ColumnBlocks
 from parameter_server_tpu.models import metrics as M
 from parameter_server_tpu.utils.config import PSConfig
 from parameter_server_tpu.utils.metrics import ProgressReporter
 
-
-@dataclass
-class ColumnBlocks:
-    """Feature-major (CSC-ish) layout of the full training set.
-
-    Entries are grouped by feature block (contiguous ranges of the dense
-    key space — the reference picks blocks from slots/feature groups; dense
-    hashed ranges are the TPU analog), padded per block to a common length
-    so a scan can sweep blocks with static shapes. Padding entries point at
-    local feature 0 / row 0 with value 0 (inert, as everywhere else)."""
-
-    feat_local: np.ndarray  # (n_blocks, E) int32 — gid - block_begin
-    rows: np.ndarray  # (n_blocks, E) int32
-    values: np.ndarray  # (n_blocks, E) float32
-    labels: np.ndarray  # (N,) float32
-    num_keys: int
-    block_size: int
-    num_examples: int
-
-    @property
-    def n_blocks(self) -> int:
-        return len(self.feat_local)
-
-    @classmethod
-    def from_batches(
-        cls, batches: list[CSRBatch], num_keys: int, n_blocks: int
-    ) -> "ColumnBlocks":
-        """Build from CSRBatches (uses their global hashed unique_keys)."""
-        if num_keys % n_blocks:
-            raise ValueError(f"num_keys {num_keys} % n_blocks {n_blocks} != 0")
-        gids, rows, vals, labels = [], [], [], []
-        row0 = 0
-        for b in batches:
-            n, e = b.num_examples, b.num_entries
-            gids.append(b.unique_keys[b.local_ids[:e]])
-            rows.append(b.row_ids[:e].astype(np.int64) + row0)
-            vals.append(b.values[:e])
-            labels.append(b.labels[:n])
-            row0 += n
-        gid = np.concatenate(gids)
-        row = np.concatenate(rows)
-        val = np.concatenate(vals)
-        y = np.concatenate(labels)
-
-        block_size = num_keys // n_blocks
-        blk = (gid // block_size).astype(np.int64)
-        order = np.argsort(blk, kind="stable")
-        gid, row, val, blk = gid[order], row[order], val[order], blk[order]
-        counts = np.bincount(blk, minlength=n_blocks)
-        e_max = max(1, int(counts.max()))
-        feat_local = np.zeros((n_blocks, e_max), dtype=np.int32)
-        rows_out = np.zeros((n_blocks, e_max), dtype=np.int32)
-        vals_out = np.zeros((n_blocks, e_max), dtype=np.float32)
-        starts = np.concatenate([[0], np.cumsum(counts)])
-        for i in range(n_blocks):
-            s, e = starts[i], starts[i + 1]
-            c = e - s
-            feat_local[i, :c] = gid[s:e] - i * block_size
-            rows_out[i, :c] = row[s:e]
-            vals_out[i, :c] = val[s:e]
-        return cls(
-            feat_local=feat_local,
-            rows=rows_out,
-            values=vals_out,
-            labels=y,
-            num_keys=num_keys,
-            block_size=block_size,
-            num_examples=len(y),
-        )
+__all__ = ["ColumnBlocks", "Darlin", "darlin_pass"]
 
 
 @functools.partial(
@@ -235,10 +167,14 @@ class Darlin:
         batches: list[CSRBatch],
         shuffle_blocks: bool = True,
     ) -> dict:
-        cfg = self.cfg
         cb = ColumnBlocks.from_batches(
-            batches, cfg.data.num_keys, cfg.solver.feature_blocks
+            batches, self.cfg.data.num_keys, self.cfg.solver.feature_blocks
         )
+        return self.fit_blocks(cb, shuffle_blocks=shuffle_blocks)
+
+    def fit_blocks(self, cb: ColumnBlocks, shuffle_blocks: bool = True) -> dict:
+        """Run the solver on prebuilt (possibly disk-cached) column blocks."""
+        cfg = self.cfg
         K, N = cb.num_keys, cb.num_examples
         w = jnp.zeros(K, dtype=jnp.float32)
         pred = jnp.zeros(N, dtype=jnp.float32)

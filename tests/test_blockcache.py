@@ -1,0 +1,127 @@
+"""Disk-backed column-block cache tests (ref: SlotReader's parse-once,
+per-slot binary cache — rebuilt here as .npy blocks + meta.json sidecar)."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data.batch import BatchBuilder
+from parameter_server_tpu.data.blockcache import (
+    ColumnBlocks,
+    cached_column_blocks,
+    load_column_blocks,
+    save_column_blocks,
+    source_fingerprint,
+)
+from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
+from parameter_server_tpu.models.darlin import Darlin
+from parameter_server_tpu.utils.config import PSConfig
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+NUM_KEYS = 128
+
+
+def _write_data(tmp_path, n=300, seed=0):
+    labels, keys, vals, _ = make_sparse_logistic(
+        n, NUM_KEYS - 2, nnz_per_example=8, seed=seed
+    )
+    p = tmp_path / "train.svm"
+    write_libsvm(p, labels, keys, vals)
+    return p
+
+
+def _cfg(files, cache_dir=""):
+    cfg = PSConfig()
+    cfg.data.files = [str(f) for f in files]
+    cfg.data.num_keys = NUM_KEYS
+    cfg.data.cache_dir = str(cache_dir)
+    cfg.solver.algo = "darlin"
+    cfg.solver.feature_blocks = 4
+    cfg.solver.block_iters = 10
+    cfg.solver.minibatch = 64
+    cfg.penalty.lambda_l1 = 0.5
+    return cfg
+
+
+def _blocks_equal(a: ColumnBlocks, b: ColumnBlocks):
+    np.testing.assert_array_equal(np.asarray(a.feat_local), np.asarray(b.feat_local))
+    np.testing.assert_array_equal(np.asarray(a.rows), np.asarray(b.rows))
+    np.testing.assert_array_equal(np.asarray(a.values), np.asarray(b.values))
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(b.labels))
+    assert (a.num_keys, a.block_size, a.num_examples) == (
+        b.num_keys,
+        b.block_size,
+        b.num_examples,
+    )
+
+
+class TestSaveLoad:
+    def test_roundtrip(self, tmp_path):
+        p = _write_data(tmp_path)
+        cfg = _cfg([p])
+        cb = cached_column_blocks(cfg)  # no cache dir: plain build
+        save_column_blocks(tmp_path / "cache", cb, "fp0")
+        loaded = load_column_blocks(tmp_path / "cache", "fp0")
+        assert loaded is not None
+        _blocks_equal(cb, loaded)
+        # mmap mode: the big arrays come back as memmaps
+        assert isinstance(loaded.values, np.memmap)
+
+    def test_missing_and_stale(self, tmp_path):
+        assert load_column_blocks(tmp_path / "nope") is None
+        p = _write_data(tmp_path)
+        cb = cached_column_blocks(_cfg([p]))
+        save_column_blocks(tmp_path / "c", cb, "fp0")
+        assert load_column_blocks(tmp_path / "c", "other-fp") is None
+        (tmp_path / "c" / "values.npy").unlink()  # incomplete cache
+        assert load_column_blocks(tmp_path / "c", "fp0") is None
+
+    def test_fingerprint_tracks_sources_and_params(self, tmp_path):
+        p = _write_data(tmp_path)
+        fp1 = source_fingerprint([str(p)], "libsvm", NUM_KEYS, 4, 512)
+        assert fp1 == source_fingerprint([str(p)], "libsvm", NUM_KEYS, 4, 512)
+        assert fp1 != source_fingerprint([str(p)], "libsvm", NUM_KEYS, 8, 512)
+        import os
+
+        os.utime(p, ns=(1, 1))  # touched source -> new fingerprint
+        assert fp1 != source_fingerprint([str(p)], "libsvm", NUM_KEYS, 4, 512)
+        with pytest.raises(FileNotFoundError):
+            source_fingerprint(["/no/such/file"], "libsvm", NUM_KEYS, 4, 512)
+
+
+class TestCachedColumnBlocks:
+    def test_second_call_skips_parsing(self, tmp_path, monkeypatch):
+        p = _write_data(tmp_path)
+        cfg = _cfg([p], cache_dir=tmp_path / "cache")
+        first = cached_column_blocks(cfg)
+
+        def boom(*a, **k):
+            raise AssertionError("cache hit must not re-parse")
+
+        import parameter_server_tpu.data.reader as reader_mod
+
+        monkeypatch.setattr(reader_mod.MinibatchReader, "__init__", boom)
+        second = cached_column_blocks(cfg)
+        _blocks_equal(first, second)
+
+    def test_rewrite_invalidates(self, tmp_path):
+        p = _write_data(tmp_path, seed=0)
+        cfg = _cfg([p], cache_dir=tmp_path / "cache")
+        first = cached_column_blocks(cfg)
+        _write_data(tmp_path, seed=1)  # rewrites train.svm
+        second = cached_column_blocks(cfg)
+        assert not np.array_equal(
+            np.asarray(first.labels), np.asarray(second.labels)
+        )
+
+    def test_darlin_same_result_from_cache(self, tmp_path):
+        p = _write_data(tmp_path)
+        cfg = _cfg([p], cache_dir=tmp_path / "cache")
+        quiet = ProgressReporter(print_fn=lambda *_: None)
+        r1 = Darlin(cfg, reporter=quiet).fit_blocks(
+            cached_column_blocks(cfg), shuffle_blocks=False
+        )
+        r2 = Darlin(cfg, reporter=quiet).fit_blocks(
+            cached_column_blocks(cfg), shuffle_blocks=False
+        )
+        assert r1["objv"] == pytest.approx(r2["objv"], rel=1e-6)
+        assert r1["nnz_w"] == r2["nnz_w"]

@@ -155,6 +155,22 @@ class TestAdfeaParity:
         flat = native.parse_chunk("criteo", tsv.read_bytes())
         assert_rows_equal(rows_from_flat(flat), list(iter_criteo(tsv)))
 
+    def test_lone_cr_matches_python(self, tmp_path):
+        """Classic-Mac '\\r' terminators: Python universal newlines split
+        there, so the native side must too."""
+        from parameter_server_tpu.data.libsvm import iter_criteo, iter_libsvm
+
+        svm = tmp_path / "m.svm"
+        svm.write_bytes(b"1 3:1\r-1 4:1\r")
+        flat = native.parse_chunk("libsvm", svm.read_bytes())
+        assert_rows_equal(rows_from_flat(flat), list(iter_libsvm(svm)))
+
+        row = "\t".join(["1"] + [str(i) for i in range(13)] + ["ff"] * 26)
+        tsv = tmp_path / "m.tsv"
+        tsv.write_bytes((row + "\r" + row + "\r").encode())
+        flat = native.parse_chunk("criteo", tsv.read_bytes())
+        assert_rows_equal(rows_from_flat(flat), list(iter_criteo(tsv)))
+
 
 class TestChunkedStreaming:
     def test_small_chunks_match_whole_file(self, tmp_path):
