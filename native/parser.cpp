@@ -73,8 +73,11 @@ inline void skip_ws(const char*& p, const char* end) {
 inline const char* find_line_end(const char* p, const char* end,
                                  const char** next_line) {
   const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-  const char* cr = static_cast<const char*>(memchr(p, '\r', end - p));
-  if (cr && (!nl || cr < nl)) {
+  // search '\r' only up to nl: scanning to end on every LF-only line would
+  // make parsing quadratic in the chunk size
+  const char* cr_stop = nl ? nl : end;
+  const char* cr = static_cast<const char*>(memchr(p, '\r', cr_stop - p));
+  if (cr) {
     *next_line = (cr + 1 < end && cr[1] == '\n') ? cr + 2 : cr + 1;
     return cr;
   }

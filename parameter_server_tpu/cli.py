@@ -37,6 +37,24 @@ def _build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--app_file", required=True)
     ev.add_argument("--model", required=True, help="text model dump")
     ev.add_argument("--data", nargs="*", default=None, help="override val files")
+
+    # multi-process tier (ref: main.cc role flags + script/local.sh)
+    nd = sub.add_parser("node", help="run one scheduler/server/worker process")
+    nd.add_argument("--role", required=True, choices=("scheduler", "server", "worker"))
+    nd.add_argument("--rank", type=int, default=0, help="ref: -my_node id")
+    nd.add_argument("--scheduler", required=True, help="host:port (ref: -scheduler)")
+    nd.add_argument("--num_servers", type=int, required=True)
+    nd.add_argument("--num_workers", type=int, required=True)
+    nd.add_argument("--app_file", required=True)
+    nd.add_argument("--model_out", default="")
+
+    la = sub.add_parser(
+        "launch", help="spawn a local multi-process run (ref: script/local.sh)"
+    )
+    la.add_argument("--app_file", required=True)
+    la.add_argument("--num_servers", type=int, default=1)
+    la.add_argument("--num_workers", type=int, default=1)
+    la.add_argument("--model_out", default="")
     return p
 
 
@@ -136,8 +154,23 @@ def main(argv: list[str] | None = None) -> int:
     cfg = load_config(args.app_file)
     if args.cmd == "train":
         out = run_train(cfg, args)
-    else:
+    elif args.cmd == "evaluate":
         out = run_evaluate(cfg, args)
+    elif args.cmd == "node":
+        from parameter_server_tpu.parallel.multislice import run_node
+
+        out = run_node(
+            cfg, args.role, args.rank, args.scheduler,
+            args.num_servers, args.num_workers, args.model_out,
+        )
+        if out is None:  # servers/workers exit silently; scheduler reports
+            return 0
+    else:
+        from parameter_server_tpu.parallel.multislice import launch_local
+
+        out = launch_local(
+            args.app_file, args.num_servers, args.num_workers, args.model_out
+        )
     print(json.dumps(out, default=float))
     return 0
 

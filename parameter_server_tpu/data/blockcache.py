@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 from dataclasses import dataclass
 from pathlib import Path
 
@@ -142,8 +143,11 @@ def save_column_blocks(cache_dir: str | Path, cb: ColumnBlocks, fingerprint: str
         "n_blocks": cb.n_blocks,
         "nnz": int((cb.values != 0).sum()),
     }
-    # sidecar written last: its presence marks a complete cache
-    (d / "meta.json").write_text(json.dumps(meta, indent=1))
+    # sidecar written last and atomically: its presence marks a complete
+    # cache, so a partial write must never be observable at the final path
+    tmp = d / "meta.json.tmp"
+    tmp.write_text(json.dumps(meta, indent=1))
+    os.replace(tmp, d / "meta.json")
 
 
 def load_column_blocks(
@@ -154,23 +158,26 @@ def load_column_blocks(
     meta_path = d / "meta.json"
     if not meta_path.exists():
         return None
-    meta = json.loads(meta_path.read_text())
-    if meta.get("version") != CACHE_VERSION:
-        return None
-    if fingerprint is not None and meta.get("fingerprint") != fingerprint:
-        return None
-    arrays = {}
-    for name in _ARRAYS:
-        p = d / f"{name}.npy"
-        if not p.exists():
+    try:
+        meta = json.loads(meta_path.read_text())
+        if meta.get("version") != CACHE_VERSION:
             return None
-        arrays[name] = np.load(p, mmap_mode="r")
-    return ColumnBlocks(
-        **arrays,
-        num_keys=meta["num_keys"],
-        block_size=meta["block_size"],
-        num_examples=meta["num_examples"],
-    )
+        if fingerprint is not None and meta.get("fingerprint") != fingerprint:
+            return None
+        arrays = {}
+        for name in _ARRAYS:
+            p = d / f"{name}.npy"
+            if not p.exists():
+                return None
+            arrays[name] = np.load(p, mmap_mode="r")
+        return ColumnBlocks(
+            **arrays,
+            num_keys=meta["num_keys"],
+            block_size=meta["block_size"],
+            num_examples=meta["num_examples"],
+        )
+    except (json.JSONDecodeError, KeyError, ValueError, OSError):
+        return None  # corrupt/truncated cache == cache miss, rebuild it
 
 
 def cached_column_blocks(cfg) -> ColumnBlocks:

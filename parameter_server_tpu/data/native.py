@@ -97,8 +97,9 @@ def parse_chunk(fmt: str, chunk: bytes, max_rows_hint: int = 0) -> FlatRows:
         raise RuntimeError("native parser not available")
     if not chunk.endswith(b"\n"):
         chunk += b"\n"
-    # capacity heuristics: a row is >= 4 bytes; an entry is >= 2 bytes
-    max_rows = max(max_rows_hint, chunk.count(b"\n") + 1)
+    # capacity heuristics: a row is >= 4 bytes; an entry is >= 2 bytes.
+    # '\r' counts too: the C parser splits rows on lone CR (classic-Mac files)
+    max_rows = max(max_rows_hint, chunk.count(b"\n") + chunk.count(b"\r") + 1)
     max_nnz = max(64, len(chunk) // 2)
     labels = np.empty(max_rows, dtype=np.float32)
     row_splits = np.empty(max_rows + 1, dtype=np.int64)
@@ -156,7 +157,12 @@ def iter_chunks(
                     yield parse_chunk(fmt, tail)
                 return
             buf = tail + buf
-            cut = buf.rfind(b"\n")
+            # cut at the last newline of either convention so CR-terminated
+            # files stream in chunks instead of accumulating to EOF; a chunk
+            # ending exactly at '\r' stays in the tail — the next read may
+            # begin with '\n' (a CRLF split across chunk boundaries)
+            stop = len(buf) - 1 if buf.endswith(b"\r") else len(buf)
+            cut = max(buf.rfind(b"\n", 0, stop), buf.rfind(b"\r", 0, stop))
             if cut < 0:
                 tail = buf
                 continue

@@ -1,0 +1,422 @@
+"""Host-side control plane over TCP: the cross-process tier.
+
+Reference analog: src/system/van.* + postoffice.* — ZeroMQ sockets carrying
+protobuf ``Task`` headers plus raw ``SArray`` payloads, dispatched to
+Customers; the scheduler holds the node registry, barriers, heartbeats and
+merged progress.
+
+On a TPU pod the *data plane* is XLA collectives (parallel/spmd.py) and this
+layer is deliberately NOT on it. What genuinely remains host-side —
+scheduler traffic (node registry, barriers, the SSP clock, the workload
+pool, progress merging, heartbeats, small blob exchange) — rides this tiny
+TCP layer, exactly the role SURVEY.md §5.8 assigns to "jax.distributed's KV
+store / a tiny host TCP layer". It is also the transport the cross-slice
+(DCN) push/pull tier builds on (parallel/multislice.py), where the
+reference's message filters become meaningful again.
+
+Wire format (ref: Message = Task proto header + SArray payloads):
+
+    u32 header_len | u32 payload_len | header JSON | payload bytes
+
+The header carries the command and scalar fields; ``arrays`` in the header
+describes the (name, dtype, shape) of each contiguous numpy payload. With
+``zip`` set the payload block is zlib-compressed (ref: the compressing
+filter, src/filter/compressing.h — byte compression earns its place back on
+a real wire).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Callable
+
+import numpy as np
+
+from parameter_server_tpu.parallel.ssp import SSPClock
+from parameter_server_tpu.parallel.workload import WorkloadPool
+from parameter_server_tpu.utils.heartbeat import HeartbeatMonitor
+from parameter_server_tpu.utils.metrics import merge_progress
+
+_LEN = struct.Struct("<II")
+
+Arrays = dict[str, np.ndarray]
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        k = sock.recv_into(view[got:], n - got)
+        if k == 0:
+            raise ConnectionError("peer closed")
+        got += k
+    return bytes(buf)
+
+
+def send_frame(
+    sock: socket.socket, header: dict[str, Any], arrays: Arrays | None = None
+) -> int:
+    """Send one framed message; returns bytes put on the wire (ref: the
+    Postoffice per-message byte counters)."""
+    arrays = arrays or {}
+    metas = []
+    chunks = []
+    for name, a in arrays.items():
+        a = np.ascontiguousarray(a)
+        metas.append([name, a.dtype.str, list(a.shape)])
+        chunks.append(a.tobytes())
+    payload = b"".join(chunks)
+    if header.get("zip"):
+        payload = zlib.compress(payload, level=1)
+    h = dict(header)
+    h["arrays"] = metas
+    hb = json.dumps(h).encode()
+    frame = _LEN.pack(len(hb), len(payload)) + hb + payload
+    sock.sendall(frame)
+    return len(frame)
+
+
+def recv_frame(sock: socket.socket) -> tuple[dict[str, Any], Arrays]:
+    hlen, plen = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    header = json.loads(_recv_exact(sock, hlen))
+    payload = _recv_exact(sock, plen) if plen else b""
+    if header.get("zip"):
+        payload = zlib.decompress(payload)
+    arrays: Arrays = {}
+    off = 0
+    for name, dtype, shape in header.pop("arrays", []):
+        dt = np.dtype(dtype)
+        n = int(np.prod(shape)) if shape else 1
+        nb = n * dt.itemsize
+        arrays[name] = np.frombuffer(
+            payload, dtype=dt, count=n, offset=off
+        ).reshape(shape)
+        off += nb
+    return header, arrays
+
+
+class RpcServer:
+    """Thread-per-connection TCP server dispatching framed requests to a
+    handler (shared by the Coordinator and the shard servers). The handler
+    may raise ``Shutdown`` to stop the server after replying."""
+
+    class Shutdown(Exception):
+        pass
+
+    def __init__(
+        self,
+        handler: Callable[[dict[str, Any], Arrays], tuple[dict[str, Any], Arrays]],
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self._handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address = f"{host}:{self._sock.getsockname()[1]}"
+        self._stop = threading.Event()
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self._accept_thread: threading.Thread | None = None
+
+    def start(self) -> "RpcServer":
+        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                header, arrays = recv_frame(conn)
+                try:
+                    rep, rep_arrays = self._handler(header, arrays)
+                except RpcServer.Shutdown:
+                    send_frame(conn, {"ok": True})
+                    self.stop()
+                    return
+                except Exception as e:  # surface handler errors to the caller
+                    rep, rep_arrays = {"ok": False, "error": repr(e)}, {}
+                self.bytes_out += send_frame(conn, rep, rep_arrays)
+        except (ConnectionError, OSError):
+            return  # client went away; its requests died with it
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """One persistent connection; requests are serialized under a lock
+    (the reference's per-remote-node send queue discipline)."""
+
+    def __init__(self, address: str, retries: int = 50, retry_delay: float = 0.1):
+        host, port = address.rsplit(":", 1)
+        last: Exception | None = None
+        for _ in range(retries):
+            try:
+                self._sock = socket.create_connection((host, int(port)), timeout=30)
+                break
+            except OSError as e:  # server may still be binding
+                last = e
+                time.sleep(retry_delay)
+        else:
+            raise ConnectionError(f"cannot reach {address}: {last}")
+        # blocking calls (barrier, ssp_wait) may legitimately park for longer
+        # than any fixed socket timeout; request-level timeouts are carried in
+        # the header and enforced server-side, the launcher is the backstop
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self.bytes_out = 0
+        self.bytes_in = 0
+
+    def call(
+        self, cmd: str, arrays: Arrays | None = None, **fields: Any
+    ) -> tuple[dict[str, Any], Arrays]:
+        header = {"cmd": cmd, **fields}
+        with self._lock:
+            self.bytes_out += send_frame(self._sock, header, arrays)
+            rep, rep_arrays = recv_frame(self._sock)
+        if not rep.get("ok", True):
+            raise RuntimeError(f"{cmd} failed remotely: {rep.get('error')}")
+        return rep, rep_arrays
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class Coordinator:
+    """The scheduler endpoint (ref: Postoffice on the scheduler node).
+
+    Owns: node registry, named barriers, a blob KV (small host arrays),
+    the workload pool, merged progress, heartbeats, and the SSP clock.
+    All commands are served by ``RpcServer`` threads; blocking commands
+    (barrier / blocking kv_get / ssp_wait) park the connection's thread.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._nodes: dict[int, dict[str, Any]] = {}
+        self._next_id = 0
+        self._barriers: dict[str, list[int]] = {}  # name -> [arrived, generation]
+        self._kv: dict[str, tuple[dict, Arrays]] = {}
+        self._pool: WorkloadPool | None = None
+        self._progress: dict[int, dict[str, Any]] = {}
+        self._monitor = HeartbeatMonitor()
+        self._clock: SSPClock | None = None
+        self._cv = threading.Condition()
+        self.server = RpcServer(self._handle, host, port).start()
+        self.address = self.server.address
+
+    # -- dispatch --------------------------------------------------------
+
+    def _handle(
+        self, header: dict[str, Any], arrays: Arrays
+    ) -> tuple[dict[str, Any], Arrays]:
+        cmd = header.pop("cmd")
+        fn = getattr(self, f"_cmd_{cmd}", None)
+        if fn is None:
+            raise ValueError(f"unknown control command {cmd!r}")
+        return fn(header, arrays)
+
+    def _cmd_register(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        with self._cv:
+            node_id = self._next_id
+            self._next_id += 1
+            self._nodes[node_id] = {"role": h.get("role", "?"), **h}
+            self._cv.notify_all()
+        return {"ok": True, "node_id": node_id}, {}
+
+    def _cmd_nodes(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        with self._cv:
+            return {"ok": True, "nodes": self._nodes}, {}
+
+    def _cmd_barrier(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        """Block until ``count`` callers reach barrier ``name`` (ref:
+        Postoffice::Barrier over node groups)."""
+        name, count = h["name"], int(h["count"])
+        with self._cv:
+            st = self._barriers.setdefault(name, [0, 0])
+            st[0] += 1
+            if st[0] >= count:
+                st[0] = 0
+                st[1] += 1
+                self._cv.notify_all()
+                return {"ok": True}, {}
+            gen = st[1]
+            ok = self._cv.wait_for(
+                lambda: self._barriers[name][1] > gen, timeout=h.get("timeout")
+            )
+        return {"ok": ok, "error": "barrier timeout" if not ok else None}, {}
+
+    def _cmd_kv_set(self, h: dict, arrays: Arrays) -> tuple[dict, Arrays]:
+        with self._cv:
+            self._kv[h["key"]] = ({"fields": h.get("fields", {})}, arrays)
+            self._cv.notify_all()
+        return {"ok": True}, {}
+
+    def _cmd_kv_get(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        key = h["key"]
+        with self._cv:
+            if h.get("block"):
+                if not self._cv.wait_for(
+                    lambda: key in self._kv, timeout=h.get("timeout")
+                ):
+                    return {"ok": False, "error": f"kv_get timeout on {key!r}"}, {}
+            if key not in self._kv:
+                return {"ok": True, "found": False}, {}
+            meta, arrays = self._kv[key]
+            return {"ok": True, "found": True, **meta}, arrays
+
+    def _cmd_workload_init(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        with self._cv:
+            if self._pool is None:
+                self._pool = WorkloadPool(h["items"])
+        return {"ok": True}, {}
+
+    def _cmd_workload_fetch(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        assert self._pool is not None, "workload_init first"
+        return {"ok": True, "workload": self._pool.fetch(int(h["worker"]))}, {}
+
+    def _cmd_workload_finish(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        assert self._pool is not None
+        self._pool.finish(h["workload"])
+        return {"ok": True}, {}
+
+    def _cmd_workload_stats(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        assert self._pool is not None
+        return {"ok": True, "stats": self._pool.stats(), "all_done": self._pool.all_done}, {}
+
+    def _cmd_progress(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        with self._cv:
+            self._progress[int(h["worker"])] = h["record"]
+        return {"ok": True}, {}
+
+    def _cmd_progress_merged(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        with self._cv:
+            reports = [dict(r) for r in self._progress.values()]
+        return {"ok": True, "merged": merge_progress(reports)}, {}
+
+    def _cmd_beat(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        self._monitor.beat(int(h["node_id"]), h.get("stats"))
+        return {"ok": True}, {}
+
+    def _cmd_dead(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        return {"ok": True, "dead": self._monitor.dead(), "alive": self._monitor.alive()}, {}
+
+    def _cmd_ssp_init(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        with self._cv:
+            if self._clock is None:
+                self._clock = SSPClock(int(h["num_workers"]), int(h["max_delay"]))
+        return {"ok": True}, {}
+
+    def _cmd_ssp_wait(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        assert self._clock is not None, "ssp_init first"
+        ok = self._clock.wait(int(h["worker"]), int(h["step"]), h.get("timeout"))
+        return {"ok": True, "granted": ok}, {}
+
+    def _cmd_ssp_finish(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        assert self._clock is not None
+        self._clock.finish(int(h["worker"]), int(h["step"]))
+        return {"ok": True}, {}
+
+    def _cmd_ssp_retire(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        assert self._clock is not None
+        self._clock.retire(int(h["worker"]))
+        return {"ok": True}, {}
+
+    def _cmd_ssp_progress(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        assert self._clock is not None
+        return {"ok": True, **self._clock.progress()}, {}
+
+    def _cmd_shutdown(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        raise RpcServer.Shutdown
+
+    def stop(self) -> None:
+        self.server.stop()
+
+
+class ControlClient(RpcClient):
+    """Typed convenience wrapper over the coordinator's commands."""
+
+    def register(self, role: str, **fields: Any) -> int:
+        rep, _ = self.call("register", role=role, **fields)
+        return int(rep["node_id"])
+
+    def barrier(self, name: str, count: int, timeout: float | None = None) -> None:
+        rep, _ = self.call("barrier", name=name, count=count, timeout=timeout)
+        if not rep["ok"]:  # pragma: no cover - timeout path
+            raise TimeoutError(f"barrier {name!r} timed out")
+
+    def kv_set(self, key: str, arrays: Arrays | None = None, **fields: Any) -> None:
+        self.call("kv_set", arrays=arrays, key=key, fields=fields)
+
+    def kv_get(
+        self, key: str, block: bool = False, timeout: float | None = None
+    ) -> tuple[dict[str, Any], Arrays] | None:
+        rep, arrays = self.call("kv_get", key=key, block=block, timeout=timeout)
+        if not rep.get("found"):
+            return None
+        return rep.get("fields", {}), arrays
+
+    def workload_init(self, items: list[str]) -> None:
+        self.call("workload_init", items=items)
+
+    def workload_fetch(self, worker: int) -> str | None:
+        rep, _ = self.call("workload_fetch", worker=worker)
+        return rep["workload"]
+
+    def workload_finish(self, workload: str) -> None:
+        self.call("workload_finish", workload=workload)
+
+    def workload_all_done(self) -> bool:
+        rep, _ = self.call("workload_stats")
+        return bool(rep["all_done"])
+
+    def progress(self, worker: int, record: dict[str, Any]) -> None:
+        self.call("progress", worker=worker, record=record)
+
+    def progress_merged(self) -> dict[str, Any]:
+        rep, _ = self.call("progress_merged")
+        return rep["merged"]
+
+    def beat(self, node_id: int, stats: dict | None = None) -> None:
+        self.call("beat", node_id=node_id, stats=stats)
+
+    def ssp_init(self, num_workers: int, max_delay: int) -> None:
+        self.call("ssp_init", num_workers=num_workers, max_delay=max_delay)
+
+    def ssp_wait(self, worker: int, step: int, timeout: float | None = None) -> bool:
+        rep, _ = self.call("ssp_wait", worker=worker, step=step, timeout=timeout)
+        return bool(rep["granted"])
+
+    def ssp_finish(self, worker: int, step: int) -> None:
+        self.call("ssp_finish", worker=worker, step=step)
+
+    def ssp_retire(self, worker: int) -> None:
+        self.call("ssp_retire", worker=worker)
+
+    def shutdown_server(self) -> None:
+        """Ask the remote RpcServer to stop (after acking)."""
+        self.call("shutdown")

@@ -1,0 +1,624 @@
+"""Cross-process parameter-server tier: range-sharded servers + slice workers.
+
+Reference analog: the whole N-servers x M-workers topology of the reference
+(scheduler assigns ranges, workers Push/Pull against servers over the wire,
+src/system/ + src/parameter/shared_parameter.h). On a TPU pod that topology
+collapses into one SPMD program (parallel/spmd.py) — THIS module is for the
+tier where a single program can't reach: separate processes/slices joined
+only by host networking (DCN), and the multi-process integration harness
+(the analog of script/local.sh, the reference's de-facto integration test).
+
+Each *server* process owns a contiguous key range of the model (ref:
+Range::EvenDivide over servers) and applies the shared updaters
+(kv/updaters.py) on push. Each *worker* process streams its assigned file
+shards (coordinator workload pool), localizes batches, pulls touched
+weights per range, computes the CSR gradient on its local device with the
+same jitted math as the single-program path (ops/sparse.py), and pushes
+per-range gradients back. Consistency is the coordinator's SSP clock
+(`max_delay`), exactly the reference's wait_time dependency.
+
+The reference's message filters come back to life on this wire
+(src/filter/): key caching (send a signature instead of the key list when
+the server has seen it), zlib compression of payload blocks, and
+fixed-point float truncation with stochastic rounding (filters/fixed_point).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any
+
+import numpy as np
+
+from parameter_server_tpu.kv.updaters import Updater
+from parameter_server_tpu.parallel.control import (
+    Arrays,
+    ControlClient,
+    Coordinator,
+    RpcClient,
+    RpcServer,
+)
+from parameter_server_tpu.utils.config import PSConfig
+from parameter_server_tpu.utils.heartbeat import host_stats
+from parameter_server_tpu.utils.keyrange import KeyRange
+
+
+def _sig(keys: np.ndarray) -> str:
+    """Key-list signature (ref: key_caching.h signatures)."""
+    return hashlib.blake2b(keys.tobytes(), digest_size=8).hexdigest()
+
+
+# Bound on cached key lists per endpoint. Streamed minibatches mostly have
+# distinct key sets (hits come from pull->push pairs and epoch repeats), so
+# an unbounded cache would grow linearly with steps; the need_keys retry
+# makes eviction always safe.
+_KEY_CACHE_CAP = 512
+
+
+class _LruSigs:
+    """Tiny LRU over signature -> value (value may be None for a set)."""
+
+    def __init__(self, cap: int = _KEY_CACHE_CAP):
+        from collections import OrderedDict
+
+        self._d: OrderedDict = OrderedDict()
+        self._cap = cap
+
+    def get(self, k):
+        v = self._d.get(k)
+        if k in self._d:
+            self._d.move_to_end(k)
+        return v
+
+    def __contains__(self, k) -> bool:
+        return k in self._d
+
+    def put(self, k, v=None) -> None:
+        self._d[k] = v
+        self._d.move_to_end(k)
+        while len(self._d) > self._cap:
+            self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+class ShardServer:
+    """One server process: updater state over its key range, served via RPC.
+
+    Commands: pull / push / dump / stats / shutdown. State lives on the
+    process's default JAX device (CPU in the simulated harness, the local
+    chip in a real multi-slice run) and updates run eagerly — this tier is
+    wire-bound, not compute-bound.
+    """
+
+    def __init__(
+        self,
+        updater: Updater,
+        key_range: KeyRange,
+        vdim: int = 1,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        import jax.numpy as jnp
+
+        self.updater = updater
+        self.range = key_range
+        self.state = updater.init(key_range.size, vdim)
+        self._jnp = jnp
+        self._key_cache = _LruSigs()  # (worker, sig) -> key array
+        self._lock = threading.Lock()
+        self.counters = {"pulls": 0, "pushes": 0, "cache_hits": 0, "need_keys": 0}
+        self.server = RpcServer(self._handle, host, port)
+        self.address = self.server.address
+
+    def start(self) -> "ShardServer":
+        self.server.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.server.start()
+        while not self.server._stop.wait(0.2):
+            pass
+
+    def _resolve_keys(
+        self, h: dict[str, Any], arrays: Arrays
+    ) -> np.ndarray | None:
+        """Key-caching filter, server side: prefer the cached list for this
+        (worker, signature); fall back to the sent keys and cache them."""
+        ck = (int(h["worker"]), h["sig"])
+        if "keys" in arrays:
+            keys = arrays["keys"].astype(np.int64)
+            self._key_cache.put(ck, keys)
+            return keys
+        keys = self._key_cache.get(ck)
+        if keys is None:
+            self.counters["need_keys"] += 1
+            return None
+        self.counters["cache_hits"] += 1
+        return keys
+
+    def _handle(self, h: dict[str, Any], arrays: Arrays):
+        cmd = h["cmd"]
+        if cmd == "pull":
+            keys = self._resolve_keys(h, arrays)
+            if keys is None:
+                return {"ok": True, "need_keys": True}, {}
+            with self._lock:
+                rows = {k: v[keys] for k, v in self.state.items()}
+                w = np.asarray(self.updater.weights(rows)).reshape(len(keys), -1)
+            self.counters["pulls"] += 1
+            return {"ok": True, "zip": h.get("zip", False)}, {"w": w.ravel()}
+        if cmd == "push":
+            keys = self._resolve_keys(h, arrays)
+            if keys is None:
+                return {"ok": True, "need_keys": True}, {}
+            g = self._decode_grad(h, arrays).reshape(len(keys), -1)
+            with self._lock:
+                rows = {k: v[keys] for k, v in self.state.items()}
+                deltas = self.updater.delta(rows, self._jnp.asarray(g))
+                self.state = {
+                    k: self.state[k].at[keys].add(deltas[k]) for k in self.state
+                }
+            self.counters["pushes"] += 1
+            return {"ok": True}, {}
+        if cmd == "dump":
+            with self._lock:
+                w = np.asarray(self.updater.weights(self.state))
+            return {"ok": True, "begin": self.range.begin, "end": self.range.end}, {
+                "w": w
+            }
+        if cmd == "stats":
+            return {
+                "ok": True,
+                **self.counters,
+                "bytes_out": self.server.bytes_out,
+                "cached_sigs": len(self._key_cache),
+            }, {}
+        if cmd == "shutdown":
+            raise RpcServer.Shutdown
+        raise ValueError(f"unknown server command {cmd!r}")
+
+    def _decode_grad(self, h: dict[str, Any], arrays: Arrays) -> np.ndarray:
+        codec_bytes = int(h.get("codec", 0))
+        if not codec_bytes:
+            return arrays["g"]
+        from parameter_server_tpu.filters.fixed_point import Encoded, FixedPointCodec
+
+        codec = FixedPointCodec(num_bytes=codec_bytes)
+        e = Encoded(
+            self._jnp.asarray(arrays["q"]),
+            self._jnp.asarray(arrays["lo"][0]),
+            self._jnp.asarray(arrays["scale"][0]),
+        )
+        return np.asarray(codec.decode(e))
+
+
+class ServerHandle:
+    """Worker-side proxy to one shard server, applying the send filters
+    (ref: SharedParameter's per-call FilterConfigs)."""
+
+    def __init__(self, address: str, rank: int, worker: int, cfg: PSConfig):
+        self.client = RpcClient(address)
+        self.rank = rank
+        self.worker = worker
+        self._sent_sigs = _LruSigs()
+        self._key_caching = cfg.filter.key_caching
+        self._zip = cfg.filter.compressing
+        self._codec_bytes = cfg.filter.fixing_float_bytes
+        self._quant_seed = 0
+        if self._codec_bytes:
+            from parameter_server_tpu.filters.fixed_point import FixedPointCodec
+
+            self._codec = FixedPointCodec(num_bytes=self._codec_bytes)
+
+    def _keyed_call(self, cmd: str, keys: np.ndarray, arrays: Arrays, **fields):
+        """Issue a keyed request, sending the key list only when the server
+        doesn't hold it (key-caching filter, worker side)."""
+        sig = _sig(keys)
+        send_keys = not (self._key_caching and sig in self._sent_sigs)
+        payload = dict(arrays)
+        if send_keys:
+            payload["keys"] = keys.astype(np.uint32)
+        rep, out = self.client.call(
+            cmd, arrays=payload, worker=self.worker, sig=sig,
+            zip=self._zip, **fields,
+        )
+        if rep.get("need_keys"):  # cache miss on a sig we believed was cached
+            payload["keys"] = keys.astype(np.uint32)
+            rep, out = self.client.call(
+                cmd, arrays=payload, worker=self.worker, sig=sig,
+                zip=self._zip, **fields,
+            )
+        self._sent_sigs.put(sig)
+        return rep, out
+
+    def pull(self, local_keys: np.ndarray) -> np.ndarray:
+        if len(local_keys) == 0:
+            return np.zeros(0, dtype=np.float32)
+        _, out = self._keyed_call("pull", local_keys, {})
+        return out["w"].astype(np.float32)
+
+    def push(self, local_keys: np.ndarray, grads: np.ndarray) -> None:
+        if len(local_keys) == 0:
+            return
+        fields: dict[str, Any] = {"codec": 0}
+        arrays: Arrays = {}
+        if self._codec_bytes:
+            import jax
+
+            e = self._codec.encode(
+                jax.random.key(self._quant_seed), grads.astype(np.float32)
+            )
+            self._quant_seed += 1
+            arrays = {
+                "q": np.asarray(e.q),
+                "lo": np.asarray(e.lo)[None],
+                "scale": np.asarray(e.scale)[None],
+            }
+            fields["codec"] = self._codec_bytes
+        else:
+            arrays = {"g": grads.astype(np.float32)}
+        self._keyed_call("push", local_keys, arrays, **fields)
+
+    def dump(self) -> tuple[int, np.ndarray]:
+        rep, out = self.client.call("dump")
+        return int(rep["begin"]), out["w"]
+
+    def stats(self) -> dict[str, Any]:
+        rep, _ = self.client.call("stats")
+        return {k: v for k, v in rep.items() if k != "ok"}
+
+    def shutdown(self) -> None:
+        self.client.call("shutdown")
+
+    def close(self) -> None:
+        self.client.close()
+
+
+# ---------------------------------------------------------------------------
+# node entry points (ref: main.cc role dispatch; spawned by launch_local or
+# the `cli node` subcommand — one process per node, like script/local.sh)
+# ---------------------------------------------------------------------------
+
+
+def run_server(cfg: PSConfig, scheduler: str, rank: int, num_servers: int) -> None:
+    from parameter_server_tpu.models.linear import updater_from_config
+
+    ranges = KeyRange(0, cfg.data.num_keys).even_divide(num_servers)
+    srv = ShardServer(updater_from_config(cfg), ranges[rank])
+    ctl = ControlClient(scheduler)
+    node_id = ctl.register("server", rank=rank)
+    ctl.kv_set(f"server_addr/{rank}", addr=srv.address)
+    ctl.beat(node_id, host_stats())
+    srv.serve_forever()  # until the scheduler's shutdown
+    ctl.close()
+
+
+def _connect_servers(
+    ctl: ControlClient, worker_rank: int, num_servers: int, cfg: PSConfig
+) -> list[ServerHandle]:
+    handles = []
+    for s in range(num_servers):
+        fields, _ = ctl.kv_get(f"server_addr/{s}", block=True, timeout=60)
+        handles.append(ServerHandle(fields["addr"], s, worker_rank, cfg))
+    return handles
+
+
+def run_worker(
+    cfg: PSConfig,
+    scheduler: str,
+    rank: int,
+    num_servers: int,
+    num_workers: int,
+    report_interval: int = 20,
+) -> None:
+    """The async-SGD worker loop over the wire (ref: AsyncSGDWorker)."""
+    import jax
+
+    from parameter_server_tpu.data.batch import BatchBuilder
+    from parameter_server_tpu.data.reader import MinibatchReader
+    from parameter_server_tpu.models import metrics as M
+    from parameter_server_tpu.ops.sparse import csr_grad, csr_logits, logistic_loss
+
+    ctl = ControlClient(scheduler)
+    node_id = ctl.register("worker", rank=rank)
+    # the scheduler's ssp_init/workload_init must land before our first
+    # fetch; registration order doesn't guarantee it, this kv flag does
+    ctl.kv_get("scheduler_init_done", block=True, timeout=120)
+    servers = _connect_servers(ctl, rank, num_servers, cfg)
+    ranges = KeyRange(0, cfg.data.num_keys).even_divide(num_servers)
+    begins = np.array([r.begin for r in ranges] + [cfg.data.num_keys])
+    builder = BatchBuilder(
+        num_keys=cfg.data.num_keys,
+        batch_size=cfg.solver.minibatch,
+        max_nnz_per_example=cfg.data.max_nnz_per_example,
+    )
+
+    @jax.jit
+    def grad_step(w_u, values, local_ids, row_ids, labels, mask):
+        logits = csr_logits(
+            w_u, values, local_ids, row_ids, num_rows=labels.shape[0]
+        )
+        loss, err = logistic_loss(logits, labels, mask)
+        g = csr_grad(err, values, local_ids, row_ids, num_unique=w_u.shape[0])
+        return loss, jax.nn.sigmoid(logits), g
+
+    pool = ThreadPoolExecutor(max_workers=max(num_servers, 1))
+    pending: deque[tuple[int, list]] = deque()  # in-flight pushes per step
+    max_delay = cfg.solver.max_delay
+    inflight_limit = max_delay if max_delay >= 0 else (1 << 30)
+
+    def drain(limit: int) -> None:
+        """Retire finished pushes; enforce the in-flight bound (ref: the
+        worker Executor blocking when the wait_time dependency is unmet)."""
+        while pending and (
+            len(pending) > limit or all(f.done() for f in pending[0][1])
+        ):
+            step_i, futs = pending.popleft()
+            for f in futs:
+                f.result()  # surface push errors
+            ctl.ssp_finish(rank, step_i)
+
+    step = 0
+    window: list[tuple[float, np.ndarray, np.ndarray]] = []
+    t0 = time.perf_counter()
+    ex_seen = 0
+
+    def flush_window() -> None:
+        """Send the window's merged Progress (ref: per-report_interval
+        Progress protos merged at the scheduler)."""
+        nonlocal window, t0
+        if not window:
+            return
+        n = sum(len(y) for _, _, y in window)
+        y = np.concatenate([y for _, _, y in window])
+        p = np.concatenate([pr for _, pr, _ in window])
+        ctl.progress(
+            rank,
+            {
+                "examples": n,
+                "examples_total": ex_seen,
+                "objv": sum(l for l, _, _ in window) / n,
+                "auc": M.auc(y, p),
+                "ex_per_sec": n / max(time.perf_counter() - t0, 1e-9),
+            },
+        )
+        ctl.beat(node_id, host_stats())
+        window = []
+        t0 = time.perf_counter()
+
+    while True:
+        workload = ctl.workload_fetch(rank)
+        if workload is None:
+            break
+        _epoch, path = workload.split(":", 1)
+        for b in MinibatchReader([path], cfg.data.format, builder):
+            # retire our own in-flight pushes first: the clock's gate for
+            # step t includes this worker's finished counter (wait_time
+            # semantics), so draining after the gate would self-deadlock
+            drain(inflight_limit)
+            ctl.ssp_wait(rank, step)
+            # slice the batch's (sorted) unique keys against server ranges
+            real = b.unique_keys[1 : b.num_unique]
+            bounds = np.searchsorted(real, begins)
+            segs = [
+                (real[bounds[s] : bounds[s + 1]] - ranges[s].begin).astype(
+                    np.uint32
+                )
+                for s in range(num_servers)
+            ]
+            pulls = list(
+                pool.map(lambda sh_seg: sh_seg[0].pull(sh_seg[1]), zip(servers, segs))
+            )
+            w_u = np.zeros(len(b.unique_keys), dtype=np.float32)
+            w_u[1 : b.num_unique] = np.concatenate(pulls) if pulls else []
+            loss, probs, g = grad_step(
+                w_u, b.values, b.local_ids, b.row_ids, b.labels, b.example_mask
+            )
+            g_real = np.asarray(g).ravel()[1 : b.num_unique]
+            futs = [
+                pool.submit(servers[s].push, segs[s], g_real[bounds[s] : bounds[s + 1]])
+                for s in range(num_servers)
+            ]
+            pending.append((step, futs))
+            ex_seen += b.num_examples
+            window.append(
+                (
+                    float(loss),
+                    np.asarray(probs)[: b.num_examples],
+                    b.labels[: b.num_examples],
+                )
+            )
+            if len(window) >= report_interval:
+                flush_window()
+            step += 1
+        ctl.workload_finish(workload)
+    drain(0)
+    flush_window()
+    ctl.ssp_retire(rank)  # out of data: stop gating the still-running workers
+    ctl.beat(node_id, host_stats())
+    ctl.barrier("train_done", num_workers + 1, timeout=600)
+    for sh in servers:
+        sh.close()
+    ctl.close()
+
+
+def run_scheduler(
+    cfg: PSConfig,
+    coordinator: Coordinator,
+    num_servers: int,
+    num_workers: int,
+    model_out: str = "",
+) -> dict[str, Any]:
+    """Drive a run: init pools/clock, wait for completion, assemble the
+    model from server dumps (ref: SaveModel, each server writes its range),
+    evaluate, shut everything down."""
+    ctl = ControlClient(coordinator.address)
+    ctl.register("scheduler")
+    ctl.ssp_init(num_workers, cfg.solver.max_delay)
+    items = [
+        f"{e}:{f}" for e in range(max(cfg.solver.epochs, 1)) for f in cfg.data.files
+    ]
+    ctl.workload_init(items)
+    ctl.kv_set("scheduler_init_done")  # workers block on this before fetching
+    ctl.barrier("train_done", num_workers + 1, timeout=600)
+
+    servers = _connect_servers(ctl, worker_rank=-1, num_servers=num_servers, cfg=cfg)
+    w = np.zeros(cfg.data.num_keys, dtype=np.float32)
+    for sh in servers:
+        begin, w_range = sh.dump()
+        w[begin : begin + len(w_range)] = w_range.reshape(-1)
+    out: dict[str, Any] = {
+        "merged": ctl.progress_merged(),
+        "server_stats": [sh.stats() for sh in servers],
+        "nnz_w": int(np.count_nonzero(w)),
+    }
+    if model_out:
+        from parameter_server_tpu.utils.checkpoint import dump_weights_text
+
+        dump_weights_text(w, model_out)
+        out["model_out"] = model_out
+    if cfg.data.val_files:
+        from parameter_server_tpu.models.evaluation import evaluate_model
+
+        ev = evaluate_model(
+            w, cfg.data.val_files, cfg.data.format, cfg.data.num_keys,
+            batch_size=cfg.solver.minibatch,
+            max_nnz_per_example=cfg.data.max_nnz_per_example,
+        )
+        out["val_auc"] = ev["auc"]
+        out["val_logloss"] = ev["logloss"]
+    for sh in servers:
+        sh.shutdown()
+        sh.close()
+    ctl.close()
+    coordinator.stop()
+    return out
+
+
+def launch_local(
+    app_file: str,
+    num_servers: int,
+    num_workers: int,
+    model_out: str = "",
+    timeout: float = 600.0,
+    devices: str = "cpu",
+) -> dict[str, Any]:
+    """Spawn scheduler + servers + workers as real processes on this host
+    (ref: script/local.sh — the de-facto integration test harness).
+
+    ``devices="cpu"`` (default) pins every spawned node to the CPU backend:
+    the harness simulates a multi-host cluster on one machine, and N
+    processes must not fight over this host's accelerator (real multi-host
+    runs get one process per host from the cluster manager, not from here).
+    ``devices="inherit"`` leaves the environment alone.
+    """
+    import os
+    import socket as socket_mod
+    import subprocess
+    import sys
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    addr = f"127.0.0.1:{port}"
+
+    child_env = dict(os.environ)
+    if devices == "cpu":
+        child_env["JAX_PLATFORMS"] = "cpu"
+        # ambient site hooks (e.g. PJRT plugins keyed off env vars) may claim
+        # the host's accelerator at interpreter start, deadlocking the N
+        # children against each other; disable the known ones for cpu mode
+        child_env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    import tempfile
+
+    logdir = tempfile.mkdtemp(prefix="pslaunch_")
+
+    def spawn(role: str, rank: int) -> subprocess.Popen:
+        cmd = [
+            sys.executable, "-m", "parameter_server_tpu.cli", "node",
+            "--role", role, "--rank", str(rank), "--scheduler", addr,
+            "--num_servers", str(num_servers), "--num_workers", str(num_workers),
+            "--app_file", app_file,
+        ]
+        if role == "scheduler" and model_out:
+            cmd += ["--model_out", model_out]
+        # child output goes to files, not PIPEs: nobody drains N pipes while
+        # training runs, and a chatty child must never block on a full pipe
+        out_f = open(f"{logdir}/{role}-{rank}.out", "w+")
+        err_f = open(f"{logdir}/{role}-{rank}.err", "w+")
+        p = subprocess.Popen(cmd, stdout=out_f, stderr=err_f, text=True, env=child_env)
+        p._ps_logs = (out_f, err_f)  # type: ignore[attr-defined]
+        p._ps_tag = f"{role}:{rank}"  # type: ignore[attr-defined]
+        return p
+
+    def logs_of(p: subprocess.Popen) -> tuple[str, str]:
+        out_f, err_f = p._ps_logs  # type: ignore[attr-defined]
+        out_f.seek(0)
+        err_f.seek(0)
+        return out_f.read(), err_f.read()
+
+    procs = [spawn("scheduler", 0)]
+    procs += [spawn("server", r) for r in range(num_servers)]
+    procs += [spawn("worker", r) for r in range(num_workers)]
+    deadline = time.monotonic() + timeout
+    timed_out = False
+    try:
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 1))
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                break
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    outs = [(p, *logs_of(p)) for p in procs]
+    for p, _, _ in outs:
+        p._ps_logs[0].close()  # type: ignore[attr-defined]
+        p._ps_logs[1].close()  # type: ignore[attr-defined]
+    if timed_out:
+        tails = "\n".join(
+            f"--- {p._ps_tag} rc={p.returncode} ---\n{err[-1500:]}"  # type: ignore[attr-defined]
+            for p, _, err in outs
+        )
+        raise RuntimeError(f"multi-process run timed out after {timeout}s:\n{tails}")
+    for p, stdout, stderr in outs:
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"node {p._ps_tag} failed rc={p.returncode}:\n{stderr[-2000:]}"  # type: ignore[attr-defined]
+            )
+    # scheduler prints the result JSON on its last stdout line
+    return json.loads(outs[0][1].strip().splitlines()[-1])
+
+
+def run_node(
+    cfg: PSConfig,
+    role: str,
+    rank: int,
+    scheduler: str,
+    num_servers: int,
+    num_workers: int,
+    model_out: str = "",
+) -> dict[str, Any] | None:
+    """Role dispatch for one spawned process (ref: App::Create + main.cc)."""
+    if role == "scheduler":
+        host, port = scheduler.rsplit(":", 1)
+        coord = Coordinator(host, int(port))
+        return run_scheduler(cfg, coord, num_servers, num_workers, model_out)
+    if role == "server":
+        run_server(cfg, scheduler, rank, num_servers)
+        return None
+    if role == "worker":
+        run_worker(cfg, scheduler, rank, num_servers, num_workers)
+        return None
+    raise ValueError(f"unknown role {role!r}")

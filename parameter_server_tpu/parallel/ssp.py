@@ -69,6 +69,13 @@ class SSPClock:
                 self._finished[worker] = step
                 self._cv.notify_all()
 
+    RETIRED = 1 << 60
+
+    def retire(self, worker: int) -> None:
+        """Mark ``worker`` done forever (out of data): it no longer gates
+        the others (ref: a finished worker stops issuing dependencies)."""
+        self.finish(worker, self.RETIRED)
+
     def progress(self) -> dict[str, int]:
         with self._cv:
             return {

@@ -62,6 +62,17 @@ class SolverConfig:
 
 
 @dataclass
+class FilterConfig:
+    """Ref: the per-task FilterConfig protos (src/filter/). On-pod traffic
+    needs none of these (static layouts over ICI); they apply to the
+    cross-process wire tier (parallel/control, parallel/multislice)."""
+
+    key_caching: bool = True  # ref: filter/key_caching.h signatures
+    compressing: bool = False  # ref: filter/compressing.h (zlib here)
+    fixing_float_bytes: int = 0  # ref: filter/fixing_float.h; 0 off, 1|2 bytes
+
+
+@dataclass
 class ParallelConfig:
     """Mesh topology: the TPU analog of -num_servers / -num_workers."""
 
@@ -78,6 +89,7 @@ class PSConfig:
     lr: LearningRateConfig = field(default_factory=LearningRateConfig)
     penalty: PenaltyConfig = field(default_factory=PenaltyConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
+    filter: FilterConfig = field(default_factory=FilterConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     model_output: str = ""
     report_interval: int = 1  # progress print cadence, in reports (ref gflag)
@@ -112,6 +124,7 @@ _NESTED = {
     "lr": LearningRateConfig,
     "penalty": PenaltyConfig,
     "solver": SolverConfig,
+    "filter": FilterConfig,
     "parallel": ParallelConfig,
 }
 

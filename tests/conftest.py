@@ -9,6 +9,12 @@ chips. Real-TPU behavior is exercised by bench.py on hardware.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env may preset a TPU platform
+# Tests (and every subprocess they spawn — CLI tests, the multi-process
+# launcher) are CPU-only by design. Ambient TPU site hooks keyed off env
+# vars would make each child claim the host's single chip at interpreter
+# start, serializing or deadlocking them; drop the trigger for the whole
+# pytest process tree.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -75,6 +75,18 @@ class TestSaveLoad:
         (tmp_path / "c" / "values.npy").unlink()  # incomplete cache
         assert load_column_blocks(tmp_path / "c", "fp0") is None
 
+    def test_corrupt_sidecar_is_a_cache_miss(self, tmp_path):
+        """A truncated meta.json (crash/disk-full mid-write) must rebuild,
+        not wedge every subsequent run with a JSONDecodeError."""
+        p = _write_data(tmp_path)
+        cb = cached_column_blocks(_cfg([p]))
+        save_column_blocks(tmp_path / "c", cb, "fp0")
+        meta = tmp_path / "c" / "meta.json"
+        meta.write_text(meta.read_text()[: len(meta.read_text()) // 2])
+        assert load_column_blocks(tmp_path / "c", "fp0") is None
+        meta.write_text('{"version": 1}')  # parseable but missing keys
+        assert load_column_blocks(tmp_path / "c") is None
+
     def test_fingerprint_tracks_sources_and_params(self, tmp_path):
         p = _write_data(tmp_path)
         fp1 = source_fingerprint([str(p)], "libsvm", NUM_KEYS, 4, 512)

@@ -171,6 +171,17 @@ class TestAdfeaParity:
         flat = native.parse_chunk("criteo", tsv.read_bytes())
         assert_rows_equal(rows_from_flat(flat), list(iter_criteo(tsv)))
 
+    def test_many_lone_cr_rows(self, tmp_path):
+        """Regression: max_rows capacity must count '\\r' rows too — 3+
+        CR-terminated lines used to overflow the row estimate."""
+        from parameter_server_tpu.data.libsvm import iter_libsvm
+
+        svm = tmp_path / "many.svm"
+        svm.write_bytes(b"".join(f"1 {k}:1\r".encode() for k in range(3, 40)))
+        flat = native.parse_chunk("libsvm", svm.read_bytes())
+        assert len(flat[0]) == 37
+        assert_rows_equal(rows_from_flat(flat), list(iter_libsvm(svm)))
+
 
 class TestChunkedStreaming:
     def test_small_chunks_match_whole_file(self, tmp_path):
@@ -182,6 +193,29 @@ class TestChunkedStreaming:
         for flat in native.iter_chunks(p, "libsvm", chunk_bytes=256):
             chunked.extend(rows_from_flat(flat))
         assert_rows_equal(chunked, whole)
+
+    def test_cr_only_file_streams_in_chunks(self, tmp_path):
+        """Lone-CR files must stream (chunks cut at '\\r'), and a CRLF pair
+        split across a chunk boundary must not create a phantom blank row."""
+        p = tmp_path / "mac.svm"
+        p.write_bytes(b"".join(f"1 {k}:1\r".encode() for k in range(3, 120)))
+        whole = rows_from_flat(native.parse_chunk("libsvm", p.read_bytes()))
+        for nbytes in (7, 8, 9, 64):  # odd sizes land cuts on/next to '\r'
+            chunked = []
+            n_chunks = 0
+            for flat in native.iter_chunks(p, "libsvm", chunk_bytes=nbytes):
+                chunked.extend(rows_from_flat(flat))
+                n_chunks += 1
+            assert n_chunks > 1  # actually streamed, not one EOF blob
+            assert_rows_equal(chunked, whole)
+        crlf = tmp_path / "win.svm"
+        crlf.write_bytes(b"".join(f"1 {k}:1\r\n".encode() for k in range(3, 120)))
+        whole = rows_from_flat(native.parse_chunk("libsvm", crlf.read_bytes()))
+        for nbytes in (7, 8, 9):
+            chunked = []
+            for flat in native.iter_chunks(crlf, "libsvm", chunk_bytes=nbytes):
+                chunked.extend(rows_from_flat(flat))
+            assert_rows_equal(chunked, whole)
 
     def test_gzip(self, tmp_path):
         import gzip
