@@ -14,9 +14,38 @@ vs_baseline — speedup over a single-core numpy implementation of the exact
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+
+def _ensure_reachable_backend(probe_timeout_s: float = 240.0) -> str:
+    """Probe the configured JAX backend in a subprocess; fall back to CPU
+    when device init hangs or fails (e.g. an accelerator tunnel outage).
+    A wedged backend would otherwise hang this process un-killably inside
+    PJRT init; the subprocess keeps the timeout enforceable."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=probe_timeout_s,
+            env=dict(os.environ),
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # disable ambient TPU hooks
+    # ambient site hooks may have imported jax already, freezing the platform
+    # default from the pre-fallback env; override via config as well
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu (fallback: accelerator unreachable)"
 
 BATCH = 8192
 NNZ_PER = 32
@@ -94,6 +123,7 @@ def bench_numpy_baseline(batches) -> float:
 
 
 def main() -> None:
+    platform = _ensure_reachable_backend()
     batches = _make_batches()
     baseline = bench_numpy_baseline(batches)
     value = bench_device(batches)
@@ -104,6 +134,7 @@ def main() -> None:
                 "value": round(value, 1),
                 "unit": "examples/sec",
                 "vs_baseline": round(value / baseline, 2),
+                "platform": platform,
             }
         )
     )

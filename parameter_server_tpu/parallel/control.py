@@ -82,10 +82,14 @@ def send_frame(
     return len(frame)
 
 
-def recv_frame(sock: socket.socket) -> tuple[dict[str, Any], Arrays]:
+def recv_frame_sized(
+    sock: socket.socket,
+) -> tuple[dict[str, Any], Arrays, int]:
+    """recv_frame plus the frame's wire size (for traffic counters)."""
     hlen, plen = _LEN.unpack(_recv_exact(sock, _LEN.size))
     header = json.loads(_recv_exact(sock, hlen))
     payload = _recv_exact(sock, plen) if plen else b""
+    nbytes = _LEN.size + hlen + plen
     if header.get("zip"):
         payload = zlib.decompress(payload)
     arrays: Arrays = {}
@@ -98,6 +102,11 @@ def recv_frame(sock: socket.socket) -> tuple[dict[str, Any], Arrays]:
             payload, dtype=dt, count=n, offset=off
         ).reshape(shape)
         off += nb
+    return header, arrays, nbytes
+
+
+def recv_frame(sock: socket.socket) -> tuple[dict[str, Any], Arrays]:
+    header, arrays, _ = recv_frame_sized(sock)
     return header, arrays
 
 
@@ -143,7 +152,8 @@ class RpcServer:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             while True:
-                header, arrays = recv_frame(conn)
+                header, arrays, nbytes = recv_frame_sized(conn)
+                self.bytes_in += nbytes
                 try:
                     rep, rep_arrays = self._handler(header, arrays)
                 except RpcServer.Shutdown:
@@ -195,7 +205,8 @@ class RpcClient:
         header = {"cmd": cmd, **fields}
         with self._lock:
             self.bytes_out += send_frame(self._sock, header, arrays)
-            rep, rep_arrays = recv_frame(self._sock)
+            rep, rep_arrays, nbytes = recv_frame_sized(self._sock)
+            self.bytes_in += nbytes
         if not rep.get("ok", True):
             raise RuntimeError(f"{cmd} failed remotely: {rep.get('error')}")
         return rep, rep_arrays
@@ -268,6 +279,9 @@ class Coordinator:
             ok = self._cv.wait_for(
                 lambda: self._barriers[name][1] > gen, timeout=h.get("timeout")
             )
+            if not ok and self._barriers[name][1] == gen:
+                st[0] -= 1  # withdraw our arrival: a later generation must
+                # not release early on a participant that already gave up
         return {"ok": ok, "error": "barrier timeout" if not ok else None}, {}
 
     def _cmd_kv_set(self, h: dict, arrays: Arrays) -> tuple[dict, Arrays]:

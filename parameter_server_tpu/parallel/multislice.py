@@ -61,31 +61,38 @@ _KEY_CACHE_CAP = 512
 
 
 class _LruSigs:
-    """Tiny LRU over signature -> value (value may be None for a set)."""
+    """Tiny thread-safe LRU over signature -> value (value may be None for a
+    set). Locked: server connection threads and the worker's in-flight push
+    threads touch these caches concurrently."""
 
     def __init__(self, cap: int = _KEY_CACHE_CAP):
         from collections import OrderedDict
 
         self._d: OrderedDict = OrderedDict()
         self._cap = cap
+        self._lock = threading.Lock()
 
     def get(self, k):
-        v = self._d.get(k)
-        if k in self._d:
-            self._d.move_to_end(k)
-        return v
+        with self._lock:
+            if k in self._d:
+                self._d.move_to_end(k)
+                return self._d[k]
+            return None
 
     def __contains__(self, k) -> bool:
-        return k in self._d
+        with self._lock:
+            return k in self._d
 
     def put(self, k, v=None) -> None:
-        self._d[k] = v
-        self._d.move_to_end(k)
-        while len(self._d) > self._cap:
-            self._d.popitem(last=False)
+        with self._lock:
+            self._d[k] = v
+            self._d.move_to_end(k)
+            while len(self._d) > self._cap:
+                self._d.popitem(last=False)
 
     def __len__(self) -> int:
-        return len(self._d)
+        with self._lock:
+            return len(self._d)
 
 
 class ShardServer:
@@ -178,6 +185,7 @@ class ShardServer:
                 "ok": True,
                 **self.counters,
                 "bytes_out": self.server.bytes_out,
+                "bytes_in": self.server.bytes_in,
                 "cached_sigs": len(self._key_cache),
             }, {}
         if cmd == "shutdown":
@@ -443,7 +451,9 @@ def run_worker(
     flush_window()
     ctl.ssp_retire(rank)  # out of data: stop gating the still-running workers
     ctl.beat(node_id, host_stats())
-    ctl.barrier("train_done", num_workers + 1, timeout=600)
+    # no timeout: training length is unbounded; the launcher (or cluster
+    # manager) is the liveness backstop, not a fixed barrier deadline
+    ctl.barrier("train_done", num_workers + 1)
     for sh in servers:
         sh.close()
     ctl.close()
@@ -467,7 +477,7 @@ def run_scheduler(
     ]
     ctl.workload_init(items)
     ctl.kv_set("scheduler_init_done")  # workers block on this before fetching
-    ctl.barrier("train_done", num_workers + 1, timeout=600)
+    ctl.barrier("train_done", num_workers + 1)  # unbounded: see run_worker
 
     servers = _connect_servers(ctl, worker_rank=-1, num_servers=num_servers, cfg=cfg)
     w = np.zeros(cfg.data.num_keys, dtype=np.float32)
