@@ -38,8 +38,9 @@ def _ensure_reachable_backend(probe_timeout_s: float = 240.0) -> str:
             return r.stdout.strip().splitlines()[-1]
     except subprocess.TimeoutExpired:
         pass
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # disable ambient TPU hooks
+    from parameter_server_tpu.utils.hostenv import force_cpu
+
+    force_cpu(os.environ)
     # ambient site hooks may have imported jax already, freezing the platform
     # default from the pre-fallback env; override via config as well
     import jax

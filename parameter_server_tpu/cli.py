@@ -61,6 +61,23 @@ def _build_parser() -> argparse.ArgumentParser:
 def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
     if not cfg.data.files:
         raise SystemExit("config data.files is empty")
+    if cfg.app == "graph_partition":
+        from parameter_server_tpu.models.graph_partition import GraphPartition
+
+        app = GraphPartition(cfg)
+        out = app.partition_files(cfg.data.files)
+        if args.model_out:
+            out["features_dumped"] = app.dump_partition(args.model_out)
+        return out
+    if cfg.app == "sketch":
+        from parameter_server_tpu.models.sketch import SketchApp
+
+        app = SketchApp(cfg)
+        app.add_files(cfg.data.files)
+        out = app.result()
+        if args.model_out:
+            out["dumped"] = app.dump_heavy_hitters(args.model_out)
+        return out
     if cfg.solver.algo == "darlin":
         from parameter_server_tpu.data.batch import BatchBuilder
         from parameter_server_tpu.data.reader import MinibatchReader

@@ -191,3 +191,35 @@ class MinibatchReader:
         finally:
             # unstick the producer if the consumer broke out early
             stop.set()
+
+
+def iter_flat_rows(files: list[str | Path], fmt: str):
+    """Yield flat CSR chunks ``(labels, row_splits, keys, vals, slots)`` from
+    text files — the raw-key stream consumed by ingest-side components that
+    don't need batches (frequency filter warmup, the sketch app). Native
+    chunk parser when available, else the Python row parsers."""
+    from parameter_server_tpu.data import native as _native
+
+    paths = sorted(map(str, files))
+    if fmt in _native.NATIVE_FORMATS and _native.native_available():
+        for f in paths:
+            yield from _native.iter_chunks(f, fmt)
+        return
+    from parameter_server_tpu.data.libsvm import iter_format
+
+    for f in paths:
+        labels, splits, keys, vals, slots = [], [0], [], [], []
+        for label, k, v, s in iter_format(fmt, f):
+            labels.append(label)
+            splits.append(splits[-1] + len(k))
+            keys.append(k)
+            vals.append(v)
+            slots.append(s)
+        if labels:
+            yield (
+                np.asarray(labels, dtype=np.float32),
+                np.asarray(splits, dtype=np.int64),
+                np.concatenate(keys) if keys else np.zeros(0, np.uint64),
+                np.concatenate(vals) if vals else np.zeros(0, np.float32),
+                np.concatenate(slots) if slots else np.zeros(0, np.uint64),
+            )

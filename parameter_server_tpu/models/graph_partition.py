@@ -1,0 +1,195 @@
+"""Streaming bipartite graph partitioning ("Parsa"-style).
+
+Reference analog: src/app/graph_partition/ — the reference tree carries a
+streaming graph-partitioning app ([UNCERTAIN] maturity there, see
+SURVEY.md §2.7): examples (U-vertices) stream past and are greedily
+assigned to one of k partitions so that the features (V-vertices) they
+touch are co-located, with a balance penalty keeping partitions even; the
+parameter server holds each feature's partition-presence state.
+
+TPU re-expression: the per-example greedy loop becomes a **batched**
+assignment — one jitted step per minibatch:
+
+  gather   presence rows for the batch's unique features        (U, k)
+  affinity A[e, p] = #features of e already present in p        (B, k)
+  score    A - balance_penalty * normalized partition sizes
+  assign   argmax_p score                                       (B,)
+  scatter  one-hot(assign) back into feature presence + sizes
+
+Within a batch, examples are assigned against the same (start-of-batch)
+presence snapshot instead of strictly one-by-one — the same
+bounded-staleness trade the DARLIN solver makes over feature blocks
+(models/darlin.py), traded for a fully static-shape XLA program. The
+presence table is row-sharded over the ``kv`` mesh axis exactly like the
+weight tables (its gather/scatter is the same pull/push pattern as
+models/linear.py train_step).
+"""
+
+from __future__ import annotations
+
+import functools
+from collections.abc import Iterable
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parameter_server_tpu.data.batch import CSRBatch
+from parameter_server_tpu.models.linear import batch_to_device
+from parameter_server_tpu.utils.config import PSConfig
+
+State = dict[str, jax.Array]  # {"presence": (K, k), "sizes": (k,)}
+
+
+def init_state(num_keys: int, num_partitions: int) -> State:
+    return {
+        "presence": jnp.zeros((num_keys, num_partitions), jnp.float32),
+        "sizes": jnp.zeros((num_partitions,), jnp.float32),
+    }
+
+
+@functools.partial(jax.jit, static_argnums=(2, 4), donate_argnums=0)
+def partition_step(
+    state: State,
+    batch: dict[str, jax.Array],
+    num_partitions: int,
+    balance_penalty: float,
+    refine_passes: int = 2,
+) -> tuple[State, jax.Array]:
+    """Assign one batch of examples; returns (new_state, assignments (B,)).
+
+    Pass 0 scores against the start-of-batch presence; the refinement
+    passes re-score against presence *including the batch's provisional
+    votes* (own vote removed), recovering most of the sequential greedy's
+    within-batch adaptivity while staying one static XLA program."""
+    idx = batch["unique_keys"]
+    local_ids, row_ids = batch["local_ids"], batch["row_ids"]
+    num_rows = batch["labels"].shape[0]
+    rows = jnp.take(state["presence"], idx, axis=0)  # (U, k) pull
+    # Binary edge weights (presence, not values): co-location is set overlap.
+    entry_w = (batch["values"] != 0).astype(jnp.float32)[:, None]
+    mask = batch["example_mask"].astype(jnp.float32)
+
+    def affinity_of(presence_rows: jax.Array) -> jax.Array:
+        # binary presence, not counts: affinity is "how many of my features
+        # are already IN p" (the replication objective), bounded by deg(e),
+        # so the balance penalty keeps a fixed exchange rate against it
+        here = (presence_rows > 0).astype(jnp.float32)
+        contrib = entry_w * jnp.take(here, local_ids, axis=0)
+        return jax.ops.segment_sum(contrib, row_ids, num_segments=num_rows)
+
+    def votes_of(assign: jax.Array) -> tuple[jax.Array, jax.Array]:
+        onehot = jax.nn.one_hot(assign, num_partitions) * mask[:, None]
+        votes = entry_w * jnp.take(onehot, row_ids, axis=0)  # (NNZ, k)
+        delta = jax.ops.segment_sum(votes, local_ids, num_segments=idx.shape[0])
+        return onehot, delta
+
+    mean_size = jnp.maximum(jnp.mean(state["sizes"]), 1.0)
+    # deterministic round-robin tie-break: a cold start (all-zero affinity)
+    # must spread examples, not argmax-pile them onto partition 0
+    tie = 1e-3 * jax.nn.one_hot(
+        jnp.arange(num_rows) % num_partitions, num_partitions
+    )
+    base = affinity_of(rows)
+    assign = jnp.argmax(
+        base - balance_penalty * state["sizes"] / mean_size + tie, axis=1
+    )
+    for _ in range(refine_passes):
+        onehot, delta = votes_of(assign)
+        batch_sizes = state["sizes"] + jnp.sum(onehot, axis=0)
+        mean2 = jnp.maximum(jnp.mean(batch_sizes), 1.0)
+        # re-score with the batch's votes in, each example's own vote
+        # removed per-entry BEFORE the presence threshold (with it in,
+        # every example would see its own features as already placed)
+        total = jnp.take(rows + delta, local_ids, axis=0)  # (NNZ, k)
+        others = total - entry_w * jnp.take(onehot, row_ids, axis=0)
+        contrib = entry_w * (others > 0).astype(jnp.float32)
+        aff = jax.ops.segment_sum(contrib, row_ids, num_segments=num_rows)
+        assign = jnp.argmax(
+            aff - balance_penalty * batch_sizes / mean2 + tie, axis=1
+        )
+    onehot, delta = votes_of(assign)
+    # pad slot 0 stays zero (its entries have value 0, so their votes are 0)
+    new_state = {
+        "presence": state["presence"].at[idx].add(delta),
+        "sizes": state["sizes"] + jnp.sum(onehot, axis=0),
+    }
+    return new_state, assign
+
+
+def partition_metrics(state: State) -> dict[str, float]:
+    """Partition quality (the quantities a partitioner is judged on):
+    replication factor (mean #partitions each touched feature lands in —
+    the communication cost proxy) and size balance (max/mean)."""
+    presence = np.asarray(state["presence"])
+    touched = presence.sum(axis=1) > 0
+    if not touched.any():
+        return {"replication": 0.0, "balance": 0.0, "features": 0}
+    reps = (presence[touched] > 0).sum(axis=1)
+    sizes = np.asarray(state["sizes"])
+    return {
+        "replication": float(reps.mean()),
+        "balance": float(sizes.max() / max(sizes.mean(), 1e-9)),
+        "features": int(touched.sum()),
+    }
+
+
+class GraphPartition:
+    """The app object (ref: the graph_partition App).
+
+    Streams example batches, maintains the sharded presence table, and
+    reports replication/balance the way the linear app reports objv/AUC."""
+
+    def __init__(self, cfg: PSConfig):
+        self.cfg = cfg
+        self.k = cfg.graph.num_partitions
+        self.balance_penalty = cfg.graph.balance_penalty
+        self.state = init_state(cfg.data.num_keys, self.k)
+        self.examples = 0
+
+    def partition(self, batches: Iterable[CSRBatch]) -> dict[str, Any]:
+        assignments: list[np.ndarray] = []
+        for b in batches:
+            dev = batch_to_device(b)
+            self.state, assign = partition_step(
+                self.state, dev, self.k, self.balance_penalty
+            )
+            assignments.append(np.asarray(assign)[: b.num_examples])
+            self.examples += b.num_examples
+        out = partition_metrics(self.state)
+        out["examples"] = self.examples
+        self.assignments = (
+            np.concatenate(assignments) if assignments else np.zeros(0, np.int64)
+        )
+        return out
+
+    def partition_files(self, files: list[str]) -> dict[str, Any]:
+        from parameter_server_tpu.data.batch import BatchBuilder
+        from parameter_server_tpu.data.reader import MinibatchReader
+
+        builder = BatchBuilder(
+            num_keys=self.cfg.data.num_keys,
+            batch_size=self.cfg.solver.minibatch,
+            max_nnz_per_example=self.cfg.data.max_nnz_per_example,
+        )
+        return self.partition(MinibatchReader(files, self.cfg.data.format, builder))
+
+    def feature_partition(self) -> np.ndarray:
+        """Per-feature home partition (argmax presence; -1 = untouched) —
+        the partition map a data-placement pass consumes."""
+        presence = np.asarray(self.state["presence"])
+        home = presence.argmax(axis=1)
+        home[presence.sum(axis=1) == 0] = -1
+        return home
+
+    def dump_partition(self, path: str) -> int:
+        """Text dump ``feature_id\\tpartition`` for touched features (the
+        graph analog of the key\\tweight model dump)."""
+        home = self.feature_partition()
+        n = 0
+        with open(path, "w") as f:
+            for fid in np.nonzero(home >= 0)[0]:
+                f.write(f"{fid}\t{home[fid]}\n")
+                n += 1
+        return n

@@ -133,6 +133,7 @@ class RpcServer:
         self._stop = threading.Event()
         self.bytes_in = 0
         self.bytes_out = 0
+        self._counter_lock = threading.Lock()  # counters shared by conn threads
         self._accept_thread: threading.Thread | None = None
 
     def start(self) -> "RpcServer":
@@ -153,7 +154,8 @@ class RpcServer:
         try:
             while True:
                 header, arrays, nbytes = recv_frame_sized(conn)
-                self.bytes_in += nbytes
+                with self._counter_lock:
+                    self.bytes_in += nbytes
                 try:
                     rep, rep_arrays = self._handler(header, arrays)
                 except RpcServer.Shutdown:
@@ -162,7 +164,9 @@ class RpcServer:
                     return
                 except Exception as e:  # surface handler errors to the caller
                     rep, rep_arrays = {"ok": False, "error": repr(e)}, {}
-                self.bytes_out += send_frame(conn, rep, rep_arrays)
+                sent = send_frame(conn, rep, rep_arrays)
+                with self._counter_lock:
+                    self.bytes_out += sent
         except (ConnectionError, OSError):
             return  # client went away; its requests died with it
 

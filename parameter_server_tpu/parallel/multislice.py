@@ -541,11 +541,9 @@ def launch_local(
 
     child_env = dict(os.environ)
     if devices == "cpu":
-        child_env["JAX_PLATFORMS"] = "cpu"
-        # ambient site hooks (e.g. PJRT plugins keyed off env vars) may claim
-        # the host's accelerator at interpreter start, deadlocking the N
-        # children against each other; disable the known ones for cpu mode
-        child_env.pop("PALLAS_AXON_POOL_IPS", None)
+        from parameter_server_tpu.utils.hostenv import force_cpu
+
+        force_cpu(child_env)
 
     import tempfile
 

@@ -62,6 +62,23 @@ class SolverConfig:
 
 
 @dataclass
+class GraphConfig:
+    """graph_partition app settings (ref: the graph_partition App config)."""
+
+    num_partitions: int = 8
+    balance_penalty: float = 1.0
+
+
+@dataclass
+class SketchConfig:
+    """sketch app settings (ref: the sketch App — distributed count-min)."""
+
+    width: int = 1 << 20
+    depth: int = 4
+    min_count: int = 2  # heavy-hitter admission threshold
+
+
+@dataclass
 class FilterConfig:
     """Ref: the per-task FilterConfig protos (src/filter/). On-pod traffic
     needs none of these (static layouts over ICI); they apply to the
@@ -90,6 +107,8 @@ class PSConfig:
     penalty: PenaltyConfig = field(default_factory=PenaltyConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
     filter: FilterConfig = field(default_factory=FilterConfig)
+    graph: GraphConfig = field(default_factory=GraphConfig)
+    sketch: SketchConfig = field(default_factory=SketchConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     model_output: str = ""
     report_interval: int = 1  # progress print cadence, in reports (ref gflag)
@@ -125,6 +144,8 @@ _NESTED = {
     "penalty": PenaltyConfig,
     "solver": SolverConfig,
     "filter": FilterConfig,
+    "graph": GraphConfig,
+    "sketch": SketchConfig,
     "parallel": ParallelConfig,
 }
 

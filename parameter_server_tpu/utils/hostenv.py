@@ -1,0 +1,29 @@
+"""Host process environment helpers.
+
+One home for the "make this process (tree) CPU-only" recipe: besides
+``JAX_PLATFORMS``, ambient site hooks keyed off env vars may claim the
+host's accelerator at interpreter start (wedging or serializing spawned
+children against each other), so those triggers must be dropped wherever
+CPU-only children are spawned — the bench fallback and the local
+multi-process launcher both use this.
+"""
+
+from __future__ import annotations
+
+from typing import MutableMapping
+
+# env vars that arm ambient accelerator-claiming site hooks
+AMBIENT_ACCELERATOR_HOOK_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+
+def force_cpu(env: MutableMapping[str, str]) -> MutableMapping[str, str]:
+    """Pin ``env`` (e.g. ``os.environ`` or a child env dict) to the CPU
+    backend and disarm known ambient accelerator hooks. Returns ``env``.
+
+    Note: if jax was already imported in this process, also run
+    ``jax.config.update("jax_platforms", "cpu")`` — an early import freezes
+    the platform default from the pre-call environment."""
+    env["JAX_PLATFORMS"] = "cpu"
+    for var in AMBIENT_ACCELERATOR_HOOK_VARS:
+        env.pop(var, None)
+    return env

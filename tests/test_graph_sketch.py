@@ -1,0 +1,225 @@
+"""Tests for the graph_partition and sketch apps (SURVEY.md §2.7's last
+two app-inventory rows)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.models.graph_partition import GraphPartition
+from parameter_server_tpu.models.sketch import SketchApp, merge_sketches
+from parameter_server_tpu.utils.config import PSConfig
+
+
+def _community_batches(builder, n_examples=512, feats_per=6, seed=0):
+    """Two communities: examples draw features from disjoint pools, so a
+    good 2-partition has replication ~1 and balance ~1."""
+    rng = np.random.default_rng(seed)
+    labels = np.zeros(n_examples, dtype=np.float32)
+    keys, vals = [], []
+    for i in range(n_examples):
+        pool = rng.integers(0, 500, feats_per) + (0 if i % 2 == 0 else 1000)
+        keys.append(np.unique(pool.astype(np.uint64)))
+        vals.append(np.ones(len(keys[-1]), dtype=np.float32))
+    bs = builder.batch_size
+    return [
+        builder.build(labels[i : i + bs], keys[i : i + bs], vals[i : i + bs])
+        for i in range(0, n_examples, bs)
+    ]
+
+
+def _cfg(**kw):
+    cfg = PSConfig()
+    cfg.app = "graph_partition"
+    cfg.data.num_keys = 1 << 13
+    cfg.solver.minibatch = 64
+    cfg.data.max_nnz_per_example = 32
+    for k, v in kw.items():
+        obj, attr = cfg, k
+        while "." in attr:
+            head, attr = attr.split(".", 1)
+            obj = getattr(obj, head)
+        setattr(obj, attr, v)
+    return cfg
+
+
+class TestGraphPartition:
+    def test_communities_get_low_replication(self):
+        from parameter_server_tpu.data.batch import BatchBuilder
+
+        cfg = _cfg(**{"graph.num_partitions": 2})
+        app = GraphPartition(cfg)
+        builder = BatchBuilder(
+            num_keys=cfg.data.num_keys, batch_size=cfg.solver.minibatch,
+            max_nnz_per_example=cfg.data.max_nnz_per_example,
+        )
+        out = app.partition(_community_batches(builder))
+        # disjoint communities: features should (almost) never replicate
+        assert out["replication"] < 1.2, out
+        assert out["balance"] < 1.5, out
+        assert out["examples"] == 512
+
+    def test_beats_random_assignment(self):
+        """The greedy step must do better than hashing examples to random
+        partitions (replication k-ways for shared features)."""
+        from parameter_server_tpu.data.batch import BatchBuilder
+
+        cfg = _cfg(**{"graph.num_partitions": 4})
+        builder = BatchBuilder(
+            num_keys=cfg.data.num_keys, batch_size=cfg.solver.minibatch,
+            max_nnz_per_example=32,
+        )
+        batches = _community_batches(builder, seed=3)
+        app = GraphPartition(cfg)
+        out = app.partition(batches)
+
+        # random baseline over the same batches
+        rng = np.random.default_rng(0)
+        presence = np.zeros((cfg.data.num_keys, 4), np.float32)
+        for b in batches:
+            assign = rng.integers(0, 4, len(b.labels))
+            onehot = np.eye(4, dtype=np.float32)[assign] * b.example_mask[:, None]
+            votes = (b.values != 0).astype(np.float32)[:, None] * onehot[b.row_ids]
+            np.add.at(presence, b.unique_keys[b.local_ids], votes)
+        touched = presence.sum(axis=1) > 0
+        random_rep = float((presence[touched] > 0).sum(axis=1).mean())
+        assert out["replication"] < random_rep * 0.75, (out, random_rep)
+
+    def test_balance_penalty_evens_sizes(self):
+        """With identical examples, a high balance penalty must spread them
+        instead of piling everything on partition 0."""
+        from parameter_server_tpu.data.batch import BatchBuilder
+
+        cfg = _cfg(**{"graph.num_partitions": 4, "graph.balance_penalty": 10.0})
+        builder = BatchBuilder(
+            num_keys=cfg.data.num_keys, batch_size=16, max_nnz_per_example=8
+        )
+        labels = np.zeros(64, np.float32)
+        keys = [np.array([5, 6, 7], np.uint64)] * 64
+        vals = [np.ones(3, np.float32)] * 64
+        batches = [
+            builder.build(labels[i : i + 16], keys[i : i + 16], vals[i : i + 16])
+            for i in range(0, 64, 16)
+        ]
+        app = GraphPartition(cfg)
+        out = app.partition(batches)
+        sizes = np.asarray(app.state["sizes"])
+        assert sizes.max() - sizes.min() <= 17, sizes  # spread, not piled
+
+    def test_dump_and_feature_partition(self, tmp_path):
+        from parameter_server_tpu.data.batch import BatchBuilder
+
+        cfg = _cfg(**{"graph.num_partitions": 2})
+        builder = BatchBuilder(
+            num_keys=cfg.data.num_keys, batch_size=cfg.solver.minibatch,
+            max_nnz_per_example=32,
+        )
+        app = GraphPartition(cfg)
+        app.partition(_community_batches(builder, n_examples=128))
+        home = app.feature_partition()
+        assert home.shape == (cfg.data.num_keys,)
+        assert (home >= -1).all() and (home < 2).all()
+        n = app.dump_partition(str(tmp_path / "parts.txt"))
+        assert n == (home >= 0).sum()
+        line = (tmp_path / "parts.txt").read_text().splitlines()[0]
+        fid, part = line.split("\t")
+        assert home[int(fid)] == int(part)
+
+    def test_cli_end_to_end(self, tmp_path):
+        from parameter_server_tpu import cli
+        from parameter_server_tpu.data.synthetic import (
+            make_sparse_logistic,
+            write_libsvm,
+        )
+
+        labels, keys, vals, _ = make_sparse_logistic(200, 300, nnz_per_example=6)
+        f = tmp_path / "g.svm"
+        write_libsvm(f, labels, keys, vals)
+        cfg = {
+            "app": "graph_partition",
+            "data": {"files": [str(f)], "num_keys": 8192, "max_nnz_per_example": 32},
+            "solver": {"minibatch": 64},
+            "graph": {"num_partitions": 4},
+        }
+        cfg_path = tmp_path / "g.json"
+        cfg_path.write_text(json.dumps(cfg))
+        out_path = tmp_path / "parts.txt"
+        rc = cli.main(
+            ["train", "--app_file", str(cfg_path), "--model_out", str(out_path)]
+        )
+        assert rc == 0
+        assert out_path.exists() and out_path.read_text().strip()
+
+
+class TestSketchApp:
+    def _cfg(self, **kw):
+        cfg = PSConfig()
+        cfg.app = "sketch"
+        cfg.sketch.width = 1 << 12
+        cfg.sketch.min_count = 3
+        for k, v in kw.items():
+            setattr(cfg.sketch, k, v)
+        return cfg
+
+    def test_heavy_hitters_exact_on_small_stream(self, rng):
+        app = SketchApp(self._cfg())
+        hot = np.array([7, 7, 7, 7, 9, 9, 9], dtype=np.uint64)
+        cold = rng.integers(100, 4000, 50).astype(np.uint64)
+        app.add(np.concatenate([hot, cold]))
+        keys, counts = app.heavy_hitters()
+        assert 7 in keys and 9 in keys
+        d = dict(zip(keys.tolist(), counts.tolist()))
+        assert d[7] >= 4 and d[9] >= 3  # count-min never under-estimates
+        # at this load the sketch is collision-free: exact counts
+        assert d[7] == 4 and d[9] == 3
+
+    def test_merge_matches_single_sketch(self, rng):
+        """Distributed story: shard-wise sketches merged == one sketch."""
+        streams = [rng.integers(0, 500, 400).astype(np.uint64) for _ in range(3)]
+        apps = [SketchApp(self._cfg()) for _ in streams]
+        for a, s in zip(apps, streams):
+            a.add(s)
+        merged = merge_sketches([a.sketch for a in apps])
+        whole = SketchApp(self._cfg())
+        whole.add(np.concatenate(streams))
+        np.testing.assert_array_equal(merged.table, whole.sketch.table)
+
+    def test_merge_shape_mismatch_raises(self):
+        a = SketchApp(self._cfg()).sketch
+        b = SketchApp(self._cfg(width=1 << 10)).sketch
+        with pytest.raises(ValueError, match="differ"):
+            merge_sketches([a, b])
+
+    def test_cli_and_files(self, tmp_path, rng):
+        from parameter_server_tpu import cli
+        from parameter_server_tpu.data.synthetic import (
+            make_sparse_logistic,
+            write_libsvm,
+        )
+
+        labels, keys, vals, _ = make_sparse_logistic(
+            300, 200, nnz_per_example=8, zipf_a=1.2
+        )
+        f = tmp_path / "s.svm"
+        write_libsvm(f, labels, keys, vals)
+        cfg = {
+            "app": "sketch",
+            "data": {"files": [str(f)], "num_keys": 8192},
+            "sketch": {"width": 4096, "min_count": 5},
+        }
+        cfg_path = tmp_path / "s.json"
+        cfg_path.write_text(json.dumps(cfg))
+        out_path = tmp_path / "hh.txt"
+        rc = cli.main(
+            ["train", "--app_file", str(cfg_path), "--model_out", str(out_path)]
+        )
+        assert rc == 0
+        lines = out_path.read_text().strip().splitlines()
+        assert lines  # zipf data: some heavy hitters exist
+        # counts sorted descending, all >= min_count
+        counts = [int(l.split("\t")[1]) for l in lines]
+        assert counts == sorted(counts, reverse=True)
+        assert min(counts) >= 5
+        # key 0 is the hottest raw zipf feature; it must be found
+        top_keys = {int(l.split("\t")[0]) for l in lines}
+        assert 0 in top_keys
