@@ -47,6 +47,15 @@ def _build_parser() -> argparse.ArgumentParser:
     nd.add_argument("--num_workers", type=int, required=True)
     nd.add_argument("--app_file", required=True)
     nd.add_argument("--model_out", default="")
+    nd.add_argument(
+        "--bind_host", default="127.0.0.1",
+        help="server bind address (0.0.0.0 to accept remote workers)",
+    )
+    nd.add_argument(
+        "--advertise_host", default="",
+        help="routable hostname published to the coordinator "
+        "(defaults to bind_host)",
+    )
 
     la = sub.add_parser(
         "launch", help="spawn a local multi-process run (ref: script/local.sh)"
@@ -179,6 +188,7 @@ def main(argv: list[str] | None = None) -> int:
         out = run_node(
             cfg, args.role, args.rank, args.scheduler,
             args.num_servers, args.num_workers, args.model_out,
+            bind_host=args.bind_host, advertise_host=args.advertise_host,
         )
         if out is None:  # servers/workers exit silently; scheduler reports
             return 0

@@ -231,14 +231,19 @@ class Coordinator:
     (barrier / blocking kv_get / ssp_wait) park the connection's thread.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_timeout_s: float = 30.0,
+    ):
         self._nodes: dict[int, dict[str, Any]] = {}
         self._next_id = 0
         self._barriers: dict[str, list[int]] = {}  # name -> [arrived, generation]
         self._kv: dict[str, tuple[dict, Arrays]] = {}
         self._pool: WorkloadPool | None = None
         self._progress: dict[int, dict[str, Any]] = {}
-        self._monitor = HeartbeatMonitor()
+        self._monitor = HeartbeatMonitor(heartbeat_timeout_s)
         self._clock: SSPClock | None = None
         self._cv = threading.Condition()
         self.server = RpcServer(self._handle, host, port).start()
@@ -265,7 +270,10 @@ class Coordinator:
 
     def _cmd_nodes(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
         with self._cv:
-            return {"ok": True, "nodes": self._nodes}, {}
+            # copy: serialization happens after the lock is released, and a
+            # concurrent register mutating the live dict mid-dumps would
+            # kill the connection thread
+            return {"ok": True, "nodes": dict(self._nodes)}, {}
 
     def _cmd_barrier(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
         """Block until ``count`` callers reach barrier ``name`` (ref:
@@ -313,18 +321,41 @@ class Coordinator:
                 self._pool = WorkloadPool(h["items"])
         return {"ok": True}, {}
 
+    def _pool_or_raise(self) -> WorkloadPool:
+        # explicit raise, not assert: must hold under ``python -O`` and
+        # surface a clear remote error to a mis-ordered client
+        if self._pool is None:
+            raise RuntimeError("workload_init must be called first")
+        return self._pool
+
+    def _clock_or_raise(self) -> SSPClock:
+        if self._clock is None:
+            raise RuntimeError("ssp_init must be called first")
+        return self._clock
+
     def _cmd_workload_fetch(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
-        assert self._pool is not None, "workload_init first"
-        return {"ok": True, "workload": self._pool.fetch(int(h["worker"]))}, {}
+        pool = self._pool_or_raise()
+        return {"ok": True, "workload": pool.fetch(int(h["worker"]))}, {}
 
     def _cmd_workload_finish(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
-        assert self._pool is not None
-        self._pool.finish(h["workload"])
+        self._pool_or_raise().finish(h["workload"])
         return {"ok": True}, {}
 
     def _cmd_workload_stats(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
-        assert self._pool is not None
-        return {"ok": True, "stats": self._pool.stats(), "all_done": self._pool.all_done}, {}
+        pool = self._pool_or_raise()
+        return {"ok": True, "stats": pool.stats(), "all_done": pool.all_done}, {}
+
+    def _cmd_workload_reassign(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        """Requeue workloads of a dead worker and/or stragglers by age
+        (ref: WorkloadPool straggler/dead reassignment, driven by the
+        scheduler's dead-node list)."""
+        pool = self._pool_or_raise()
+        requeued: list[str] = []
+        if h.get("worker") is not None:
+            requeued += pool.reassign_worker(int(h["worker"]))
+        if h.get("older_than") is not None:
+            requeued += pool.reassign_stragglers(float(h["older_than"]))
+        return {"ok": True, "requeued": requeued}, {}
 
     def _cmd_progress(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
         with self._cv:
@@ -350,23 +381,20 @@ class Coordinator:
         return {"ok": True}, {}
 
     def _cmd_ssp_wait(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
-        assert self._clock is not None, "ssp_init first"
-        ok = self._clock.wait(int(h["worker"]), int(h["step"]), h.get("timeout"))
+        clock = self._clock_or_raise()
+        ok = clock.wait(int(h["worker"]), int(h["step"]), h.get("timeout"))
         return {"ok": True, "granted": ok}, {}
 
     def _cmd_ssp_finish(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
-        assert self._clock is not None
-        self._clock.finish(int(h["worker"]), int(h["step"]))
+        self._clock_or_raise().finish(int(h["worker"]), int(h["step"]))
         return {"ok": True}, {}
 
     def _cmd_ssp_retire(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
-        assert self._clock is not None
-        self._clock.retire(int(h["worker"]))
+        self._clock_or_raise().retire(int(h["worker"]))
         return {"ok": True}, {}
 
     def _cmd_ssp_progress(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
-        assert self._clock is not None
-        return {"ok": True, **self._clock.progress()}, {}
+        return {"ok": True, **self._clock_or_raise().progress()}, {}
 
     def _cmd_shutdown(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
         raise RpcServer.Shutdown
@@ -411,6 +439,27 @@ class ControlClient(RpcClient):
     def workload_all_done(self) -> bool:
         rep, _ = self.call("workload_stats")
         return bool(rep["all_done"])
+
+    def workload_stats(self) -> dict[str, int]:
+        rep, _ = self.call("workload_stats")
+        return rep["stats"]
+
+    def workload_reassign(
+        self, worker: int | None = None, older_than: float | None = None
+    ) -> list[str]:
+        rep, _ = self.call(
+            "workload_reassign", worker=worker, older_than=older_than
+        )
+        return rep["requeued"]
+
+    def nodes(self) -> dict[str, dict[str, Any]]:
+        """Registry snapshot; keys are node-id strings (JSON wire)."""
+        rep, _ = self.call("nodes")
+        return rep["nodes"]
+
+    def dead_nodes(self) -> tuple[list[int], list[int]]:
+        rep, _ = self.call("dead")
+        return rep["dead"], rep["alive"]
 
     def progress(self, worker: int, record: dict[str, Any]) -> None:
         self.call("progress", worker=worker, record=record)

@@ -44,7 +44,7 @@ from parameter_server_tpu.parallel.control import (
     RpcServer,
 )
 from parameter_server_tpu.utils.config import PSConfig
-from parameter_server_tpu.utils.heartbeat import host_stats
+from parameter_server_tpu.utils.heartbeat import HeartbeatReporter
 from parameter_server_tpu.utils.keyrange import KeyRange
 
 
@@ -111,6 +111,7 @@ class ShardServer:
         vdim: int = 1,
         host: str = "127.0.0.1",
         port: int = 0,
+        advertise_host: str = "",
     ):
         import jax.numpy as jnp
 
@@ -120,9 +121,23 @@ class ShardServer:
         self._jnp = jnp
         self._key_cache = _LruSigs()  # (worker, sig) -> key array
         self._lock = threading.Lock()
+        self._ctr_lock = threading.Lock()  # counters bumped by conn threads
         self.counters = {"pulls": 0, "pushes": 0, "cache_hits": 0, "need_keys": 0}
+        if host in ("0.0.0.0", "::", "") and not advertise_host:
+            raise ValueError(
+                "binding a wildcard address requires advertise_host: "
+                "publishing 0.0.0.0 to the coordinator would point remote "
+                "workers at their own loopback"
+            )
         self.server = RpcServer(self._handle, host, port)
-        self.address = self.server.address
+        # bind and advertise may differ: bind 0.0.0.0 to accept remote
+        # workers, advertise a routable hostname via the coordinator KV
+        _, bound_port = self.server.address.rsplit(":", 1)
+        self.address = f"{advertise_host or host}:{bound_port}"
+
+    def _bump(self, name: str) -> None:
+        with self._ctr_lock:
+            self.counters[name] += 1
 
     def start(self) -> "ShardServer":
         self.server.start()
@@ -145,9 +160,9 @@ class ShardServer:
             return keys
         keys = self._key_cache.get(ck)
         if keys is None:
-            self.counters["need_keys"] += 1
+            self._bump("need_keys")
             return None
-        self.counters["cache_hits"] += 1
+        self._bump("cache_hits")
         return keys
 
     def _handle(self, h: dict[str, Any], arrays: Arrays):
@@ -159,7 +174,7 @@ class ShardServer:
             with self._lock:
                 rows = {k: v[keys] for k, v in self.state.items()}
                 w = np.asarray(self.updater.weights(rows)).reshape(len(keys), -1)
-            self.counters["pulls"] += 1
+            self._bump("pulls")
             return {"ok": True, "zip": h.get("zip", False)}, {"w": w.ravel()}
         if cmd == "push":
             keys = self._resolve_keys(h, arrays)
@@ -172,7 +187,7 @@ class ShardServer:
                 self.state = {
                     k: self.state[k].at[keys].add(deltas[k]) for k in self.state
                 }
-            self.counters["pushes"] += 1
+            self._bump("pushes")
             return {"ok": True}, {}
         if cmd == "dump":
             with self._lock:
@@ -211,7 +226,16 @@ class ServerHandle:
     """Worker-side proxy to one shard server, applying the send filters
     (ref: SharedParameter's per-call FilterConfigs)."""
 
-    def __init__(self, address: str, rank: int, worker: int, cfg: PSConfig):
+    def __init__(
+        self,
+        address: str,
+        rank: int,
+        worker: int,
+        cfg: PSConfig,
+        range_size: int = 0,
+    ):
+        import itertools
+
         self.client = RpcClient(address)
         self.rank = rank
         self.worker = worker
@@ -219,7 +243,15 @@ class ServerHandle:
         self._key_caching = cfg.filter.key_caching
         self._zip = cfg.filter.compressing
         self._codec_bytes = cfg.filter.fixing_float_bytes
-        self._quant_seed = 0
+        # local (range-relative) keys ride the wire as u32 when the range
+        # fits, u64 otherwise — a silent u32 truncation at 10^9+ feature
+        # scale would corrupt the model
+        self._key_dtype = (
+            np.uint64 if range_size > (1 << 32) else np.uint32
+        )
+        # atomic: concurrent in-flight push threads must not reuse a
+        # stochastic-rounding seed
+        self._quant_seed = itertools.count()
         if self._codec_bytes:
             from parameter_server_tpu.filters.fixed_point import FixedPointCodec
 
@@ -232,13 +264,13 @@ class ServerHandle:
         send_keys = not (self._key_caching and sig in self._sent_sigs)
         payload = dict(arrays)
         if send_keys:
-            payload["keys"] = keys.astype(np.uint32)
+            payload["keys"] = keys.astype(self._key_dtype)
         rep, out = self.client.call(
             cmd, arrays=payload, worker=self.worker, sig=sig,
             zip=self._zip, **fields,
         )
         if rep.get("need_keys"):  # cache miss on a sig we believed was cached
-            payload["keys"] = keys.astype(np.uint32)
+            payload["keys"] = keys.astype(self._key_dtype)
             rep, out = self.client.call(
                 cmd, arrays=payload, worker=self.worker, sig=sig,
                 zip=self._zip, **fields,
@@ -261,9 +293,9 @@ class ServerHandle:
             import jax
 
             e = self._codec.encode(
-                jax.random.key(self._quant_seed), grads.astype(np.float32)
+                jax.random.key(next(self._quant_seed)),
+                grads.astype(np.float32),
             )
-            self._quant_seed += 1
             arrays = {
                 "q": np.asarray(e.q),
                 "lo": np.asarray(e.lo)[None],
@@ -295,26 +327,94 @@ class ServerHandle:
 # ---------------------------------------------------------------------------
 
 
-def run_server(cfg: PSConfig, scheduler: str, rank: int, num_servers: int) -> None:
+class _RemoteBeatSink:
+    """Adapter giving ``HeartbeatReporter`` a coordinator RPC sink.
+
+    Opens its OWN connection: the node's main ControlClient serializes
+    calls under a lock and legitimately parks for long stretches
+    (blocking kv_get, ssp_wait) — beats riding that lock would stall and
+    read as a dead node exactly when the node is merely waiting."""
+
+    def __init__(self, scheduler: str):
+        self._scheduler = scheduler
+        self._ctl: ControlClient | None = ControlClient(scheduler)
+
+    def beat(self, node_id: int, stats: dict | None = None) -> None:
+        # a single transient socket failure must not silence beats forever
+        # (a healthy node would read as dead): drop the connection and
+        # rebuild it on the next beat
+        try:
+            if self._ctl is None:
+                self._ctl = ControlClient(
+                    self._scheduler, retries=1, retry_delay=0.0
+                )
+            self._ctl.beat(node_id, stats)
+        except Exception:
+            if self._ctl is not None:
+                self._ctl.close()
+            self._ctl = None
+
+    def close(self) -> None:
+        if self._ctl is not None:
+            self._ctl.close()
+
+
+class _Beats:
+    """A node's liveness heartbeat: HeartbeatReporter over a dedicated
+    coordinator connection (ref: the reference's heartbeat thread —
+    liveness must not depend on training cadence)."""
+
+    def __init__(self, scheduler: str, node_id: int, interval_s: float):
+        self._sink = _RemoteBeatSink(scheduler)
+        self._rep = HeartbeatReporter(self._sink, node_id, interval_s)
+        self._rep.start()
+
+    def stop(self) -> None:
+        self._rep.stop()
+        self._sink.close()
+
+
+def run_server(
+    cfg: PSConfig,
+    scheduler: str,
+    rank: int,
+    num_servers: int,
+    bind_host: str = "127.0.0.1",
+    advertise_host: str = "",
+) -> None:
+    """One server process. ``bind_host="0.0.0.0"`` + a routable
+    ``advertise_host`` lets workers on other hosts connect (the default
+    loopback pair only serves the single-host multi-process harness)."""
     from parameter_server_tpu.models.linear import updater_from_config
 
     ranges = KeyRange(0, cfg.data.num_keys).even_divide(num_servers)
-    srv = ShardServer(updater_from_config(cfg), ranges[rank])
+    srv = ShardServer(
+        updater_from_config(cfg),
+        ranges[rank],
+        host=bind_host,
+        advertise_host=advertise_host,
+    )
     ctl = ControlClient(scheduler)
     node_id = ctl.register("server", rank=rank)
     ctl.kv_set(f"server_addr/{rank}", addr=srv.address)
-    ctl.beat(node_id, host_stats())
+    beats = _Beats(scheduler, node_id, cfg.fault.heartbeat_interval_s)
     srv.serve_forever()  # until the scheduler's shutdown
+    beats.stop()
     ctl.close()
 
 
 def _connect_servers(
     ctl: ControlClient, worker_rank: int, num_servers: int, cfg: PSConfig
 ) -> list[ServerHandle]:
+    ranges = KeyRange(0, cfg.data.num_keys).even_divide(num_servers)
     handles = []
     for s in range(num_servers):
         fields, _ = ctl.kv_get(f"server_addr/{s}", block=True, timeout=60)
-        handles.append(ServerHandle(fields["addr"], s, worker_rank, cfg))
+        handles.append(
+            ServerHandle(
+                fields["addr"], s, worker_rank, cfg, range_size=ranges[s].size
+            )
+        )
     return handles
 
 
@@ -323,7 +423,6 @@ def run_worker(
     scheduler: str,
     rank: int,
     num_servers: int,
-    num_workers: int,
     report_interval: int = 20,
 ) -> None:
     """The async-SGD worker loop over the wire (ref: AsyncSGDWorker)."""
@@ -336,6 +435,7 @@ def run_worker(
 
     ctl = ControlClient(scheduler)
     node_id = ctl.register("worker", rank=rank)
+    beats = _Beats(scheduler, node_id, cfg.fault.heartbeat_interval_s)
     # the scheduler's ssp_init/workload_init must land before our first
     # fetch; registration order doesn't guarantee it, this kv flag does
     ctl.kv_get("scheduler_init_done", block=True, timeout=120)
@@ -397,14 +497,20 @@ def run_worker(
                 "ex_per_sec": n / max(time.perf_counter() - t0, 1e-9),
             },
         )
-        ctl.beat(node_id, host_stats())
         window = []
         t0 = time.perf_counter()
 
     while True:
         workload = ctl.workload_fetch(rank)
         if workload is None:
-            break
+            if ctl.workload_all_done():
+                break
+            # nothing pending, but another worker still holds active
+            # shards — if it dies the scheduler requeues them, so keep
+            # polling instead of exiting (ref: the pool is drained only
+            # when every shard is FINISHED, not merely assigned)
+            time.sleep(0.2)
+            continue
         _epoch, path = workload.split(":", 1)
         for b in MinibatchReader([path], cfg.data.format, builder):
             # retire our own in-flight pushes first: the clock's gate for
@@ -415,10 +521,9 @@ def run_worker(
             # slice the batch's (sorted) unique keys against server ranges
             real = b.unique_keys[1 : b.num_unique]
             bounds = np.searchsorted(real, begins)
+            # range-relative int64; the handle picks the wire dtype
             segs = [
-                (real[bounds[s] : bounds[s + 1]] - ranges[s].begin).astype(
-                    np.uint32
-                )
+                real[bounds[s] : bounds[s + 1]] - ranges[s].begin
                 for s in range(num_servers)
             ]
             pulls = list(
@@ -450,10 +555,11 @@ def run_worker(
     drain(0)
     flush_window()
     ctl.ssp_retire(rank)  # out of data: stop gating the still-running workers
-    ctl.beat(node_id, host_stats())
-    # no timeout: training length is unbounded; the launcher (or cluster
-    # manager) is the liveness backstop, not a fixed barrier deadline
-    ctl.barrier("train_done", num_workers + 1)
+    # completion signal (replaces a fixed barrier: a barrier over
+    # num_workers+1 can never release once a worker dies — the scheduler's
+    # monitor loop instead waits for every rank to be done-or-dead)
+    ctl.kv_set(f"worker_done/{rank}")
+    beats.stop()
     for sh in servers:
         sh.close()
     ctl.close()
@@ -477,7 +583,65 @@ def run_scheduler(
     ]
     ctl.workload_init(items)
     ctl.kv_set("scheduler_init_done")  # workers block on this before fetching
-    ctl.barrier("train_done", num_workers + 1)  # unbounded: see run_worker
+
+    # Monitor loop (ref: the scheduler's dead-node handling): wait until
+    # every worker rank is done or dead; requeue a dead worker's shards and
+    # retire its SSP clock so survivors neither strand its work nor block
+    # on its staleness gate. A plain barrier cannot do this — it would park
+    # forever on the dead worker's missing arrival.
+    dead_ranks: set[int] = set()
+    t_start = time.monotonic()
+
+    def declare_dead(r: int, why: str) -> None:
+        requeued = ctl.workload_reassign(worker=r)
+        ctl.ssp_retire(r)
+        dead_ranks.add(r)
+        print(
+            f"[scheduler] worker {r} {why}; requeued {len(requeued)} "
+            f"shard(s), retired its clock",
+            flush=True,
+        )
+
+    while True:
+        done = {
+            r
+            for r in range(num_workers)
+            if ctl.kv_get(f"worker_done/{r}") is not None
+        }
+        if done | dead_ranks >= set(range(num_workers)):
+            break
+        registry = ctl.nodes()
+        dead_ids, _alive = ctl.dead_nodes()
+        for nid in dead_ids:
+            info = registry.get(str(nid), {})
+            role = info.get("role")
+            if role == "server":
+                # a dead server is unrecoverable (its key range is gone):
+                # fail fast with the cause instead of letting workers hang
+                # on its socket until the launcher timeout
+                raise RuntimeError(
+                    f"shard server rank {info.get('rank')} died "
+                    "(missed heartbeats); aborting the run"
+                )
+            if role != "worker":
+                continue
+            r = int(info.get("rank", -1))
+            if r not in dead_ranks and r not in done:
+                declare_dead(r, "dead (missed heartbeats)")
+        if time.monotonic() - t_start > cfg.fault.startup_grace_s:
+            # a rank that NEVER registered is in neither the dead list
+            # (no beats recorded) nor done — without this it would park
+            # the monitor forever (e.g. the process crashed on startup)
+            registered = {
+                int(n["rank"])
+                for n in registry.values()
+                if n.get("role") == "worker" and "rank" in n
+            }
+            for r in set(range(num_workers)) - registered - dead_ranks - done:
+                declare_dead(r, "never registered (startup failure?)")
+        if cfg.fault.straggler_reassign_s > 0:
+            ctl.workload_reassign(older_than=cfg.fault.straggler_reassign_s)
+        time.sleep(0.5)
 
     servers = _connect_servers(ctl, worker_rank=-1, num_servers=num_servers, cfg=cfg)
     w = np.zeros(cfg.data.num_keys, dtype=np.float32)
@@ -488,6 +652,8 @@ def run_scheduler(
         "merged": ctl.progress_merged(),
         "server_stats": [sh.stats() for sh in servers],
         "nnz_w": int(np.count_nonzero(w)),
+        "workloads": ctl.workload_stats(),
+        "dead_workers": sorted(dead_ranks),
     }
     if model_out:
         from parameter_server_tpu.utils.checkpoint import dump_weights_text
@@ -519,6 +685,7 @@ def launch_local(
     model_out: str = "",
     timeout: float = 600.0,
     devices: str = "cpu",
+    fault_kill: str = "",
 ) -> dict[str, Any]:
     """Spawn scheduler + servers + workers as real processes on this host
     (ref: script/local.sh — the de-facto integration test harness).
@@ -528,6 +695,11 @@ def launch_local(
     processes must not fight over this host's accelerator (real multi-host
     runs get one process per host from the cluster manager, not from here).
     ``devices="inherit"`` leaves the environment alone.
+
+    ``fault_kill="worker:1@2.0"`` is the fault-injection hook (SURVEY §5.3:
+    "fault injection = kill a host process in the simulated integration
+    test"): SIGKILL the named node 2.0s after it registers with the
+    coordinator, exercising dead-node detection + workload requeue.
     """
     import os
     import socket as socket_mod
@@ -576,6 +748,33 @@ def launch_local(
     procs = [spawn("scheduler", 0)]
     procs += [spawn("server", r) for r in range(num_servers)]
     procs += [spawn("worker", r) for r in range(num_workers)]
+    killed_tag = ""
+    if fault_kill:
+        role_rank, delay_s = fault_kill.split("@")
+        kill_role, kill_rank = role_rank.split(":")
+        killed_tag = f"{kill_role}:{int(kill_rank)}"
+        victim = next(p for p in procs if p._ps_tag == killed_tag)  # type: ignore[attr-defined]
+
+        def assassin() -> None:
+            # wait for the victim to REGISTER first: killing a process that
+            # never reached the coordinator would leave the scheduler unable
+            # to tell "dead" from "still starting up"
+            ctl = ControlClient(addr, retries=600)
+            try:
+                while True:
+                    if any(
+                        n.get("role") == kill_role
+                        and int(n.get("rank", -1)) == int(kill_rank)
+                        for n in ctl.nodes().values()
+                    ):
+                        break
+                    time.sleep(0.2)
+            finally:
+                ctl.close()
+            time.sleep(float(delay_s))
+            victim.kill()
+
+        threading.Thread(target=assassin, daemon=True).start()
     deadline = time.monotonic() + timeout
     timed_out = False
     try:
@@ -601,7 +800,7 @@ def launch_local(
         )
         raise RuntimeError(f"multi-process run timed out after {timeout}s:\n{tails}")
     for p, stdout, stderr in outs:
-        if p.returncode != 0:
+        if p.returncode != 0 and p._ps_tag != killed_tag:  # type: ignore[attr-defined]
             raise RuntimeError(
                 f"node {p._ps_tag} failed rc={p.returncode}:\n{stderr[-2000:]}"  # type: ignore[attr-defined]
             )
@@ -617,16 +816,23 @@ def run_node(
     num_servers: int,
     num_workers: int,
     model_out: str = "",
+    bind_host: str = "127.0.0.1",
+    advertise_host: str = "",
 ) -> dict[str, Any] | None:
     """Role dispatch for one spawned process (ref: App::Create + main.cc)."""
     if role == "scheduler":
         host, port = scheduler.rsplit(":", 1)
-        coord = Coordinator(host, int(port))
+        coord = Coordinator(
+            host, int(port), heartbeat_timeout_s=cfg.fault.heartbeat_timeout_s
+        )
         return run_scheduler(cfg, coord, num_servers, num_workers, model_out)
     if role == "server":
-        run_server(cfg, scheduler, rank, num_servers)
+        run_server(
+            cfg, scheduler, rank, num_servers,
+            bind_host=bind_host, advertise_host=advertise_host,
+        )
         return None
     if role == "worker":
-        run_worker(cfg, scheduler, rank, num_servers, num_workers)
+        run_worker(cfg, scheduler, rank, num_servers)
         return None
     raise ValueError(f"unknown role {role!r}")

@@ -95,6 +95,21 @@ class ParallelConfig:
 
     kv_shards: int = 1  # 'kv' mesh axis: range-sharded state (servers)
     data_shards: int = 1  # 'data' mesh axis: example shards (workers)
+    # "per_worker": each worker's push is its own server updater step
+    # (reference semantics); "aggregate": pre-sum grads across workers with
+    # one psum and update once (exact for linear SGD; see parallel/spmd.py)
+    push_mode: str = "per_worker"
+
+
+@dataclass
+class FaultConfig:
+    """Failure detection / recovery knobs for the multi-process tier
+    (ref: heartbeat_info + the scheduler's dead-node handling)."""
+
+    heartbeat_interval_s: float = 2.0  # node -> scheduler beat cadence
+    heartbeat_timeout_s: float = 10.0  # overdue beats mark a node dead
+    straggler_reassign_s: float = 0.0  # age-based workload requeue; 0 off
+    startup_grace_s: float = 60.0  # rank never registered by then => dead
 
 
 @dataclass
@@ -110,6 +125,7 @@ class PSConfig:
     graph: GraphConfig = field(default_factory=GraphConfig)
     sketch: SketchConfig = field(default_factory=SketchConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    fault: FaultConfig = field(default_factory=FaultConfig)
     model_output: str = ""
     report_interval: int = 1  # progress print cadence, in reports (ref gflag)
     seed: int = 0
@@ -147,6 +163,7 @@ _NESTED = {
     "graph": GraphConfig,
     "sketch": SketchConfig,
     "parallel": ParallelConfig,
+    "fault": FaultConfig,
 }
 
 

@@ -345,3 +345,57 @@ class TestLaunchLocal:
         # both servers did real work
         for st in out["server_stats"]:
             assert st["pushes"] > 0 and st["pulls"] > 0
+        # nothing stranded, nobody died
+        assert out["workloads"] == {"pending": 0, "active": 0, "done": 12}
+        assert out["dead_workers"] == []
+
+    def test_worker_killed_mid_run_recovers(self, tmp_path, rng):
+        """Fault injection (SURVEY §5.3): SIGKILL a worker mid-run; the
+        scheduler's dead-node monitor must requeue its shards and retire
+        its SSP clock so the survivor finishes ALL workloads."""
+        from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
+        from parameter_server_tpu.parallel.multislice import launch_local
+
+        labels, keys, vals, _ = make_sparse_logistic(
+            3000, 800, nnz_per_example=10, noise=0.3, seed=13
+        )
+        files = []
+        for i in range(4):
+            sl = slice(i * 700, (i + 1) * 700)
+            f = tmp_path / f"part-{i}.libsvm"
+            write_libsvm(f, labels[sl], keys[sl], vals[sl])
+            files.append(str(f))
+        val = tmp_path / "val.libsvm"
+        write_libsvm(val, labels[2800:], keys[2800:], vals[2800:])
+
+        n_epochs = 6  # enough work that the kill always lands mid-run
+        cfg = {
+            "app": "linear_method",
+            "data": {
+                "files": files,
+                "format": "libsvm",
+                "num_keys": 1 << 15,
+                "val_files": [str(val)],
+                "max_nnz_per_example": 64,
+            },
+            "solver": {
+                "algo": "ftrl", "minibatch": 256, "max_delay": 1,
+                "epochs": n_epochs,
+            },
+            "lr": {"alpha": 0.3, "beta": 1.0},
+            "penalty": {"lambda_l1": 0.005},
+            "fault": {"heartbeat_interval_s": 0.5, "heartbeat_timeout_s": 2.5},
+        }
+        app_file = tmp_path / "app.json"
+        app_file.write_text(json.dumps(cfg))
+
+        out = launch_local(
+            str(app_file), num_servers=2, num_workers=2,
+            timeout=420, fault_kill="worker:1@1.5",
+        )
+        assert out["dead_workers"] == [1], out
+        # every workload finished despite the death — requeue worked
+        assert out["workloads"] == {
+            "pending": 0, "active": 0, "done": 4 * n_epochs,
+        }, out
+        assert out["val_auc"] > 0.85, out
