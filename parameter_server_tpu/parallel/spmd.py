@@ -116,17 +116,67 @@ def _local_push(
     return new_state
 
 
+def _local_push_aggregate(
+    updater: Updater,
+    state_l: State,
+    idx: jax.Array,  # (U,) this data shard's unique keys
+    grad: jax.Array,  # (U, vdim) this data shard's per-key grads
+    shard_size: int,
+) -> State:
+    """Aggregate-then-update push (the BASELINE north star's
+    "push ≡ reduce-scatter"): every data shard scatters its grads into a
+    dense buffer covering ONLY this device's kv range, a single ``psum``
+    over "data" pre-sums them, and the updater applies ONE step to the
+    touched rows.
+
+    vs ``_local_push``: O(1) updater applications instead of an O(D)
+    serialized scan, and the wire moves 2·S rows (ring psum of the range
+    slice) instead of D·U gathered rows — the win grows with data shards.
+
+    Semantic difference (documented, opt-in): the reference server applies
+    each worker's push as its own updater step; this mode applies the
+    SUMMED gradient once. For linear deltas (plain SGD, lambda_l2=0) the
+    two are exactly equal; for FTRL/AdaGrad this is standard synchronous
+    minibatch aggregation (same fixed point, different trajectory).
+    """
+    begin = lax.axis_index("kv") * shard_size
+    local = idx - begin
+    in_range = (local >= 0) & (local < shard_size)
+    safe = jnp.where(in_range, local, 0)
+    mask = in_range[:, None].astype(grad.dtype)
+    vdim = grad.shape[-1]
+    g_slice = jnp.zeros((shard_size, vdim), grad.dtype).at[safe].add(mask * grad)
+    touched = jnp.zeros((shard_size, 1), grad.dtype).at[safe].add(mask)
+    # one collective pre-sums every worker's contribution to this range
+    g_slice = lax.psum(g_slice, "data")
+    touched = lax.psum(touched, "data")
+    deltas = updater.delta(state_l, g_slice)
+    hit = (touched > 0).astype(grad.dtype)
+    return {k: state_l[k] + hit * deltas[k] for k in state_l}
+
+
 def _shard_size(num_keys: int, kv_size: int) -> int:
     if num_keys % kv_size:
         raise ValueError(f"num_keys {num_keys} not divisible by kv axis {kv_size}")
     return num_keys // kv_size
 
 
-def make_spmd_train_step(updater: Updater, mesh: Mesh, num_keys: int):
+def make_spmd_train_step(
+    updater: Updater, mesh: Mesh, num_keys: int, push_mode: str = "per_worker"
+):
     """Build the jitted multi-device train step.
 
     step(state, batch) -> (state, {"loss_sum": scalar, "probs": (D, B)})
+
+    push_mode:
+      "per_worker" — faithful reference semantics: each data shard's push is
+          its own server updater step (all_gather + sequential scan).
+      "aggregate"  — pre-sum per-key grads across data shards with one psum,
+          apply one updater step (see ``_local_push_aggregate``; exactly
+          equal for linear SGD, standard sync aggregation otherwise).
     """
+    if push_mode not in ("per_worker", "aggregate"):
+        raise ValueError(f"unknown push_mode {push_mode!r}")
     shard_size = _shard_size(num_keys, mesh.shape["kv"])
 
     def local_step(state_l: State, batch: Batch):
@@ -143,10 +193,17 @@ def make_spmd_train_step(updater: Updater, mesh: Mesh, num_keys: int):
         g = csr_grad(
             err, b["values"], b["local_ids"], b["row_ids"], num_unique=idx.shape[0]
         )
-        # Push: every data shard's (keys, grads) reach every kv shard.
-        all_idx = lax.all_gather(idx, "data")  # (D, U)
-        all_grad = lax.all_gather(g, "data")  # (D, U, vdim)
-        new_state = _local_push(updater, state_l, all_idx, all_grad, shard_size)
+        if push_mode == "aggregate":
+            new_state = _local_push_aggregate(
+                updater, state_l, idx, g, shard_size
+            )
+        else:
+            # Push: every data shard's (keys, grads) reach every kv shard.
+            all_idx = lax.all_gather(idx, "data")  # (D, U)
+            all_grad = lax.all_gather(g, "data")  # (D, U, vdim)
+            new_state = _local_push(
+                updater, state_l, all_idx, all_grad, shard_size
+            )
         loss_sum = lax.psum(loss, "data")
         probs = jax.nn.sigmoid(logits)[None, :]  # (1, B) -> gathers to (D, B)
         return new_state, loss_sum, probs

@@ -26,21 +26,36 @@ def linear_step_traffic(
     kv_shards: int,
     value_bytes: int = 4,
     index_bytes: int = 4,
+    push_mode: str = "per_worker",
+    num_keys: int = 0,
 ) -> StepTraffic:
     """Traffic of the sparse-LR SPMD step (parallel.spmd).
 
     pull: psum over 'kv' of a (U, vdim) float array — ring all-reduce moves
     ~2 * (S-1)/S of the array per device.
-    push: all_gather over 'data' of (U,) indices + (U, vdim) grads — ring
-    gather moves (D-1)/D of the full gathered size per device."""
+    push, per_worker mode: all_gather over 'data' of (U,) indices +
+    (U, vdim) grads — ring gather moves (D-1)/D of the full gathered size
+    per device.
+    push, aggregate mode: psum over 'data' of the dense
+    (num_keys/kv_shards, vdim) range slice (+ the touched-count column) —
+    ~2 * (D-1)/D of the slice per device, independent of D·U. Crossover:
+    aggregate wins when 2·(S+...)·slice < D·U rows, i.e. for dense-enough
+    batches or large worker counts."""
     u = unique_capacity
     pull = 0
     if kv_shards > 1:
         pull = int(2 * (kv_shards - 1) / kv_shards * u * vdim * value_bytes)
     push = 0
     if data_shards > 1:
-        full = data_shards * u * (index_bytes + vdim * value_bytes)
-        push = int((data_shards - 1) / data_shards * full)
+        if push_mode == "aggregate":
+            if num_keys <= 0:
+                raise ValueError("aggregate mode needs num_keys")
+            slice_rows = num_keys // kv_shards
+            full = slice_rows * (vdim + 1) * value_bytes  # grads + touched col
+            push = int(2 * (data_shards - 1) / data_shards * full)
+        else:
+            full = data_shards * u * (index_bytes + vdim * value_bytes)
+            push = int((data_shards - 1) / data_shards * full)
     return StepTraffic(pull, push, pull + push)
 
 

@@ -99,7 +99,8 @@ class PodTrainer:
         self.data_shards = self.mesh.shape["data"]
         self.updater = updater_from_config(cfg)
         self.step_fn = make_spmd_train_step(
-            self.updater, self.mesh, cfg.data.num_keys
+            self.updater, self.mesh, cfg.data.num_keys,
+            push_mode=cfg.parallel.push_mode,
         )
         self.predict_fn = make_spmd_predict_step(
             self.updater, self.mesh, cfg.data.num_keys
