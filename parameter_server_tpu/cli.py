@@ -32,6 +32,14 @@ def _build_parser() -> argparse.ArgumentParser:
     tr.add_argument(
         "--report_interval", type=int, default=50, help="steps between reports"
     )
+    # multi-host pod bootstrap (ref: -scheduler ip:port -my_node ...): run
+    # one identical process per host with the same coordinator address
+    tr.add_argument(
+        "--coordinator", default="",
+        help="host:port of process 0 for jax.distributed (multi-host pods)",
+    )
+    tr.add_argument("--num_processes", type=int, default=1)
+    tr.add_argument("--process_id", type=int, default=0)
 
     ev = sub.add_parser("evaluate", help="evaluate a dumped model")
     ev.add_argument("--app_file", required=True)
@@ -101,7 +109,20 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
                 "--resume is not supported for the darlin batch solver "
                 "(it restarts from its cached column blocks)"
             )
-        app = Darlin(cfg)
+        if args.coordinator:
+            # silently ignoring the flag would run N independent solvers
+            # clobbering each other's cache/model outputs
+            raise SystemExit(
+                "--coordinator is not supported for the darlin batch solver "
+                "(distributed darlin runs on one process's mesh via "
+                "parallel.data_shards/kv_shards)"
+            )
+        mesh = None
+        if cfg.parallel.data_shards * cfg.parallel.kv_shards > 1:
+            from parameter_server_tpu.parallel import make_mesh
+
+            mesh = make_mesh(cfg.parallel.data_shards, cfg.parallel.kv_shards)
+        app = Darlin(cfg, mesh=mesh)
         # SlotReader behavior: with data.cache_dir set, the first run parses
         # text and writes the columnar block cache; re-runs mmap it instead.
         from parameter_server_tpu.data.blockcache import cached_column_blocks
@@ -133,6 +154,45 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
             y = np.concatenate([b.labels[: b.num_examples] for b in val])
             out["val_auc"] = M.auc(y, p)
             out["val_logloss"] = M.logloss(y, p)
+        return out
+
+    # pod path: a mesh bigger than 1x1 (or an explicit coordinator) routes
+    # the flagship app through PodTrainer over the (data, kv) device mesh
+    if args.coordinator or cfg.parallel.data_shards * cfg.parallel.kv_shards > 1:
+        from parameter_server_tpu.parallel import runtime as runtime_mod
+        from parameter_server_tpu.parallel.trainer import PodTrainer
+        from parameter_server_tpu.utils.checkpoint import dump_weights_text
+
+        # the config's data_shards is the GLOBAL data axis, honored
+        # verbatim (multi-host runs must set it to a multiple of
+        # num_processes; runtime.init validates)
+        rt = runtime_mod.init(
+            args.coordinator or None,
+            args.num_processes,
+            args.process_id,
+            kv_shards=cfg.parallel.kv_shards,
+            data_shards=cfg.parallel.data_shards,
+        )
+        trainer = PodTrainer(cfg, runtime=rt)
+        if args.resume:
+            if not args.ckpt_dir:
+                raise SystemExit("--resume requires --ckpt_dir")
+            trainer.load(args.ckpt_dir)
+        out = dict(
+            trainer.train_files(
+                cfg.data.files, report_every=args.report_interval
+            )
+            or {}
+        )
+        if args.ckpt_dir:
+            trainer.save(args.ckpt_dir)
+        if args.model_out and rt.process_index == 0:
+            dump_weights_text(trainer.full_weights().ravel(), args.model_out)
+        if cfg.data.val_files:
+            ev = trainer.evaluate_files(cfg.data.val_files)
+            out.update({f"val_{k}": v for k, v in ev.items()})
+        out["process_index"] = rt.process_index
+        out["mesh"] = {"data": rt.data_shards, "kv": rt.kv_shards}
         return out
 
     from parameter_server_tpu.models.linear import LinearMethod

@@ -11,7 +11,9 @@ Push/Pull are XLA collectives on ICI instead of ZeroMQ messages; the SSP
 bounded-delay clock is a host-side gate on step dispatch.
 """
 
+from parameter_server_tpu.parallel import runtime  # noqa: F401
 from parameter_server_tpu.parallel.mesh import make_mesh  # noqa: F401
+from parameter_server_tpu.parallel.runtime import Runtime  # noqa: F401
 from parameter_server_tpu.parallel.spmd import (  # noqa: F401
     make_spmd_predict_step,
     make_spmd_train_step,

@@ -58,12 +58,15 @@ def stack_fields(
     batches: list, fields: tuple[str, ...], mesh: Mesh | None = None
 ) -> Batch:
     """Stack the named attributes of D per-worker batches on a leading axis;
-    with a mesh, place the result sharded over the "data" axis."""
+    with a mesh, place the result sharded over the "data" axis. Without a
+    mesh the stacks stay host-side numpy — callers either feed them to jit
+    directly or hand them to Runtime.globalize_batch (which must not pay a
+    device round-trip first)."""
     import numpy as np
 
     out = {f: np.stack([getattr(b, f) for b in batches]) for f in fields}
     if mesh is None:
-        return {k: jnp.asarray(v) for k, v in out.items()}
+        return out
     sh = NamedSharding(mesh, batch_spec())
     return {k: jax.device_put(v, sh) for k, v in out.items()}
 
@@ -166,7 +169,11 @@ def make_spmd_train_step(
 ):
     """Build the jitted multi-device train step.
 
-    step(state, batch) -> (state, {"loss_sum": scalar, "probs": (D, B)})
+    step(state, batch) -> (state, out) with out keys:
+      "loss_sum" — scalar, psum over data
+      "examples" — scalar pod-wide real-example count (the host-side
+          termination signal; see PodTrainer's drained contract)
+      "probs"    — (D, B) per-shard probabilities
 
     push_mode:
       "per_worker" — faithful reference semantics: each data shard's push is
@@ -205,21 +212,30 @@ def make_spmd_train_step(
                 updater, state_l, all_idx, all_grad, shard_size
             )
         loss_sum = lax.psum(loss, "data")
+        # pod-wide real-example count: the host-side termination signal
+        # (a drained host keeps feeding empty batches; every host stops
+        # deterministically after retiring a step with examples == 0 —
+        # this rides async dispatch instead of a blocking host barrier)
+        examples = lax.psum(jnp.sum(b["example_mask"]), "data")
         probs = jax.nn.sigmoid(logits)[None, :]  # (1, B) -> gathers to (D, B)
-        return new_state, loss_sum, probs
+        return new_state, loss_sum, examples, probs
 
     step = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(state_spec(), batch_spec()),
-        out_specs=(state_spec(), P(), batch_spec()),
+        out_specs=(state_spec(), P(), P(), batch_spec()),
         check_vma=False,
     )
 
     @functools.partial(jax.jit, donate_argnums=0)
     def jitted(state: State, batch: Batch):
-        new_state, loss_sum, probs = step(state, batch)
-        return new_state, {"loss_sum": loss_sum, "probs": probs}
+        new_state, loss_sum, examples, probs = step(state, batch)
+        return new_state, {
+            "loss_sum": loss_sum,
+            "examples": examples,
+            "probs": probs,
+        }
 
     return jitted
 

@@ -30,10 +30,10 @@ from parameter_server_tpu.data.reader import MinibatchReader
 from parameter_server_tpu.models import metrics as M
 from parameter_server_tpu.models.linear import updater_from_config
 from parameter_server_tpu.parallel.mesh import make_mesh
+from parameter_server_tpu.parallel.runtime import Runtime
 from parameter_server_tpu.parallel.spmd import (
     make_spmd_predict_step,
     make_spmd_train_step,
-    shard_state,
     stack_batches,
 )
 from parameter_server_tpu.parallel.ssp import SSPClock
@@ -91,12 +91,25 @@ class PodTrainer:
         cfg: PSConfig,
         mesh=None,
         reporter: ProgressReporter | None = None,
+        runtime: Runtime | None = None,
     ):
         self.cfg = cfg
-        self.mesh = mesh or make_mesh(
-            cfg.parallel.data_shards, cfg.parallel.kv_shards
-        )
+        if runtime is not None:
+            self.runtime = runtime
+        else:
+            m = mesh or make_mesh(cfg.parallel.data_shards, cfg.parallel.kv_shards)
+            self.runtime = Runtime(
+                mesh=m,
+                process_index=0,
+                process_count=1,
+                data_shards=m.shape["data"],
+                kv_shards=m.shape["kv"],
+                local_data_shards=m.shape["data"],
+            )
+        self.mesh = self.runtime.mesh
         self.data_shards = self.mesh.shape["data"]
+        # this process feeds only its own data rows (multi-host contract)
+        self.local_data_shards = self.runtime.local_data_shards
         self.updater = updater_from_config(cfg)
         self.step_fn = make_spmd_train_step(
             self.updater, self.mesh, cfg.data.num_keys,
@@ -105,8 +118,8 @@ class PodTrainer:
         self.predict_fn = make_spmd_predict_step(
             self.updater, self.mesh, cfg.data.num_keys
         )
-        self.state = shard_state(
-            self.updater.init(cfg.data.num_keys, 1), self.mesh
+        self.state = self.runtime.init_state(
+            lambda: self.updater.init(cfg.data.num_keys, 1)
         )
         self.reporter = reporter or ProgressReporter()
         self.clock = SSPClock(
@@ -132,44 +145,58 @@ class PodTrainer:
         cfg = self.cfg
         last: dict = {}
         for _ in range(max(1, cfg.solver.epochs)):
-            pool = WorkloadPool(list(files))
+            # per-host pool over this host's local data rows. Contract:
+            # callers pass the FULL file list on every host; the trainer
+            # applies runtime.shard_files exactly once here (pre-sharding
+            # upstream would double-shard and silently drop files)
+            pool = WorkloadPool(self.runtime.shard_files(files))
             streams = [
                 _WorkerStream(w, pool, cfg.data.format, self._builder(key_mode))
-                for w in range(self.data_shards)
+                for w in range(self.local_data_shards)
             ]
             last = self._train_epoch(streams, report_every) or last
         return last
 
     def _train_epoch(self, streams: list[_WorkerStream], report_every: int) -> dict:
-        in_flight: deque = deque()  # (step_idx, loss_arr, probs_arr, labels, n)
+        in_flight: deque = deque()  # (step, loss, examples, probs, labels, n)
         window: list = []
         n_since = 0
         t0 = time.perf_counter()
         step_idx = 0
         last: dict = {}
+        drained = False  # a retired step reported 0 pod-wide examples
 
         def _retire(entry) -> None:
-            nonlocal n_since
-            _, loss_arr, probs, labels, n = entry
+            nonlocal drained
+            _, loss_arr, examples_arr, probs, labels, n = entry
             jax.block_until_ready(loss_arr)
             self.clock.finish(0, entry[0])
-            window.append((float(loss_arr), np.asarray(probs), labels))
+            if float(examples_arr) == 0.0:
+                drained = True
+            window.append(
+                (float(loss_arr), self.runtime.localize_data(probs), labels)
+            )
 
+        # Termination contract (multi-host safe): a host whose local
+        # streams dry up keeps issuing steps with all-empty batches — every
+        # process must issue the same collectives — and ALL hosts stop
+        # after retiring the first step whose pod-wide example count
+        # (psum'd inside the step) is zero. The SSP gate's retirement
+        # schedule is deterministic, so every host stops at the same step
+        # index with no blocking host-side barrier on the dispatch path.
         while True:
-            batches = [s.next_batch() for s in streams]
-            live = [b for b in batches if b is not None]
-            if not live:
-                break
-            batches = [
-                b if b is not None else streams[i]._empty()
-                for i, b in enumerate(batches)
-            ]
             # SSP gate: block until step (t - tau - 1) has fully completed
             target = step_idx - self.clock.max_delay - 1
             while in_flight and in_flight[0][0] <= target:
                 _retire(in_flight.popleft())
-
-            stacked = stack_batches(batches, self.mesh)
+            if drained:
+                break
+            batches = [s.next_batch() for s in streams]
+            batches = [
+                b if b is not None else streams[i]._empty()
+                for i, b in enumerate(batches)
+            ]
+            stacked = self.runtime.globalize_batch(stack_batches(batches, None))
             self.state, out = self.step_fn(self.state, stacked)
             n = sum(b.num_examples for b in batches)
             self.examples_seen += n
@@ -179,7 +206,10 @@ class PodTrainer:
             )
             mask_counts = [b.num_examples for b in batches]
             in_flight.append(
-                (step_idx, out["loss_sum"], out["probs"], (labels, mask_counts), n)
+                (
+                    step_idx, out["loss_sum"], out["examples"], out["probs"],
+                    (labels, mask_counts), n,
+                )
             )
             step_idx += 1
             if step_idx % report_every == 0:
@@ -211,9 +241,49 @@ class PodTrainer:
             ssp=self.clock.progress(),
         )
 
+    def full_weights(self) -> np.ndarray:
+        """Materialize the (num_keys, vdim) weight vector on this host from
+        its local replica of the kv-sharded state."""
+        import jax.numpy as jnp
+
+        host = self.runtime.state_to_host(self.state)
+        return np.asarray(
+            self.updater.weights({k: jnp.asarray(v) for k, v in host.items()})
+        )
+
+    def save(self, ckpt_dir, meta: dict | None = None) -> None:
+        """Per-host sharded checkpoint (each host writes its key-range
+        slice; ref: each server dumps its own range)."""
+        self.runtime.save_checkpoint(
+            ckpt_dir,
+            self.state,
+            meta={"examples_seen": self.examples_seen, **(meta or {})},
+        )
+        self.runtime.barrier("ckpt_saved")
+
+    def load(self, ckpt_dir) -> dict:
+        self.state, meta = self.runtime.load_checkpoint(ckpt_dir)
+        self.examples_seen = int(meta.get("examples_seen", 0))
+        return meta
+
     def evaluate_files(self, files: list[str], key_mode: str = "hash") -> dict:
         """Pod-wide batch evaluation using the predict step on shard 0's
         stream layout (eval is read-only; one worker suffices)."""
+        if self.runtime.process_count > 1:
+            # multi-host: evaluate host-locally against the full weight
+            # vector (every host holds a complete replica) — no cross-host
+            # collectives, so hosts may evaluate different file sets
+            from parameter_server_tpu.models.evaluation import evaluate_model
+
+            return evaluate_model(
+                self.full_weights().ravel(),
+                files,
+                self.cfg.data.format,
+                self.cfg.data.num_keys,
+                batch_size=self.cfg.solver.minibatch,
+                max_nnz_per_example=self.cfg.data.max_nnz_per_example,
+                key_mode=key_mode,
+            )
         builder = self._builder(key_mode)
         reader = MinibatchReader(files, self.cfg.data.format, builder)
         ys, ps = [], []

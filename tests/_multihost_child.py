@@ -1,0 +1,62 @@
+"""Child process for tests/test_multihost.py: one simulated host of a
+2-process pod (SURVEY §4(b): multi-process simulation on CPU via
+jax.distributed + xla_force_host_platform_device_count).
+
+Usage: python _multihost_child.py <coordinator> <nprocs> <pid> <workdir>
+Prints one JSON line with this host's results.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+
+
+def main() -> None:
+    coord, nprocs, pid, workdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    from parameter_server_tpu.parallel import runtime
+    from parameter_server_tpu.parallel.trainer import PodTrainer
+    from parameter_server_tpu.utils.config import PSConfig, load_config
+
+    rt = runtime.init(coord, nprocs, pid, kv_shards=2)
+    cfg = load_config(f"{workdir}/app.json")
+    files = [f"{workdir}/part-{i}.libsvm" for i in range(4)]
+    val = [f"{workdir}/val.libsvm"]
+
+    trainer = PodTrainer(cfg, runtime=rt)
+    last = trainer.train_files(files, report_every=10)
+    ev = trainer.evaluate_files(val)
+
+    # per-host sharded checkpoint, then a fresh trainer resumes from it and
+    # must reproduce the exact same full weight replica
+    trainer.save(f"{workdir}/ckpt")
+    resumed = PodTrainer(cfg, runtime=rt)
+    resumed.load(f"{workdir}/ckpt")
+    w0 = trainer.full_weights()
+    w1 = resumed.full_weights()
+    assert (w0 == w1).all(), "resume did not reproduce the weights"
+    digest = hashlib.blake2b(w0.tobytes(), digest_size=12).hexdigest()
+
+    print(
+        "RESULT "
+        + json.dumps(
+            {
+                "pid": pid,
+                "data_shards": rt.data_shards,
+                "local_data_shards": rt.local_data_shards,
+                "val_auc": ev["auc"],
+                "val_examples": ev["examples"],
+                "examples_seen": trainer.examples_seen,
+                "weights_digest": digest,
+                "nnz_w": int((w0 != 0).sum()),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
