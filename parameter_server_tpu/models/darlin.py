@@ -38,7 +38,89 @@ from parameter_server_tpu.models import metrics as M
 from parameter_server_tpu.utils.config import PSConfig
 from parameter_server_tpu.utils.metrics import ProgressReporter
 
-__all__ = ["ColumnBlocks", "Darlin", "darlin_pass"]
+__all__ = [
+    "ColumnBlocks",
+    "Darlin",
+    "darlin_pass",
+    "make_darlin_spmd_fns",
+    "shard_blocks_for_mesh",
+]
+
+
+# ---------------------------------------------------------------------------
+# Per-block coordinate math, shared verbatim by the single-device and SPMD
+# solvers — the 2e-4 trajectory-parity contract between them depends on the
+# formulas living in exactly one place. The distributed path injects its
+# cross-shard reduction through ``reduce`` (identity vs psum over "data").
+# ---------------------------------------------------------------------------
+
+
+def _kkt_viol(w_b: jax.Array, g: jax.Array, lambda_l1: float) -> jax.Array:
+    """KKT violation per coordinate (ref: the filter score deciding the
+    active set)."""
+    return jnp.where(
+        w_b != 0.0,
+        jnp.abs(g + jnp.sign(w_b) * lambda_l1),
+        jnp.maximum(jnp.abs(g) - lambda_l1, 0.0),
+    )
+
+
+def _prox_newton_direction(
+    w_b: jax.Array,
+    g: jax.Array,
+    h: jax.Array,
+    skip: jax.Array,
+    lambda_l1: float,
+    lambda_l2: float,
+    learning_rate: float,
+) -> jax.Array:
+    """Proximal Newton direction per coordinate (diagonal model):
+    z = w*h - eta*g ; d = soft_threshold(z, eta*lambda_l1)/h - w."""
+    h_safe = h + lambda_l2 + 1e-6
+    z = w_b * h_safe - learning_rate * g
+    w_cand = (
+        jnp.sign(z)
+        * jnp.maximum(jnp.abs(z) - learning_rate * lambda_l1, 0.0)
+        / h_safe
+    )
+    return jnp.where(skip, 0.0, w_cand - w_b)
+
+
+def _line_search_alpha(
+    pred: jax.Array,
+    Xd: jax.Array,
+    y: jax.Array,
+    w_b: jax.Array,
+    d: jax.Array,
+    lambda_l1: float,
+    lambda_l2: float,
+    mask: jax.Array | None = None,
+    reduce=lambda x: x,
+):
+    """Simultaneous coordinate updates can overshoot when block features
+    co-occur (the diagonal model ignores coupling; the reference's bounded
+    update is its safeguard). Safeguard here: evaluate the TRUE objective at
+    8 geometric step scales in parallel and take the best — one fused (T, N)
+    softplus sweep, fully static for XLA. ``reduce`` sums nll terms across
+    example shards in the distributed solver."""
+    alphas = 0.5 ** jnp.arange(8, dtype=jnp.float32)  # 1, 1/2, ..., 1/128
+    zs = pred[None, :] + alphas[:, None] * Xd[None, :]  # (T, N)
+    terms = jax.nn.softplus(zs) - y[None, :] * zs
+    terms0 = jax.nn.softplus(pred) - y * pred
+    if mask is not None:
+        terms = terms * mask[None, :]
+        terms0 = terms0 * mask
+    nll = reduce(jnp.sum(terms, axis=1))
+    wa = w_b[None, :] + alphas[:, None] * d[None, :]  # (T, block)
+    reg = lambda_l1 * jnp.abs(wa).sum(axis=1) + 0.5 * lambda_l2 * (wa * wa).sum(axis=1)
+    obj_a = nll + reg
+    obj_0 = (
+        reduce(jnp.sum(terms0))
+        + lambda_l1 * jnp.abs(w_b).sum()
+        + 0.5 * lambda_l2 * (w_b * w_b).sum()
+    )
+    best = jnp.argmin(obj_a)
+    return jnp.where(obj_a[best] < obj_0, alphas[best], 0.0)
 
 
 @functools.partial(
@@ -92,48 +174,19 @@ def darlin_pass(
         w_b = jax.lax.dynamic_slice(w, (begin,), (block_size,))
         act_b = jax.lax.dynamic_slice(active, (begin,), (block_size,))
 
-        # KKT violation (reference: the filter score deciding the active set)
-        viol = jnp.where(
-            w_b != 0.0,
-            jnp.abs(g + jnp.sign(w_b) * lambda_l1),
-            jnp.maximum(jnp.abs(g) - lambda_l1, 0.0),
-        )
+        viol = _kkt_viol(w_b, g, lambda_l1)
         viol_max = jnp.maximum(viol_max, viol.max())
         # inactive zero-weight coords with tiny gradient are skipped
         skip = (~act_b) & (w_b == 0.0)
-
-        h_safe = h + lambda_l2 + 1e-6
-        # proximal Newton direction per coordinate (diagonal model):
-        #   z = w*h - eta*g ; d = soft_threshold(z, eta*lambda_l1)/h - w
-        z = w_b * h_safe - learning_rate * g
-        w_cand = (
-            jnp.sign(z)
-            * jnp.maximum(jnp.abs(z) - learning_rate * lambda_l1, 0.0)
-            / h_safe
+        d = _prox_newton_direction(
+            w_b, g, h, skip, lambda_l1, lambda_l2, learning_rate
         )
-        d = jnp.where(skip, 0.0, w_cand - w_b)
-
-        # Simultaneous coordinate updates can overshoot when block features
-        # co-occur (the diagonal model ignores coupling; the reference's
-        # bounded update is its safeguard). Safeguard here: evaluate the TRUE
-        # objective at 8 geometric step scales in parallel and take the best
-        # — one fused (T, N) softplus sweep, fully static for XLA.
         Xd = jax.ops.segment_sum(
             vals * jnp.take(d, fl), rows, num_segments=num_examples
         )
-        alphas = 0.5 ** jnp.arange(8, dtype=jnp.float32)  # 1, 1/2, ..., 1/128
-        zs = pred[None, :] + alphas[:, None] * Xd[None, :]  # (T, N)
-        nll = jnp.sum(jax.nn.softplus(zs) - y[None, :] * zs, axis=1)
-        wa = w_b[None, :] + alphas[:, None] * d[None, :]  # (T, block)
-        reg = lambda_l1 * jnp.abs(wa).sum(axis=1) + 0.5 * lambda_l2 * (wa * wa).sum(axis=1)
-        obj_a = nll + reg
-        obj_0 = (
-            jnp.sum(jax.nn.softplus(pred) - y * pred)
-            + lambda_l1 * jnp.abs(w_b).sum()
-            + 0.5 * lambda_l2 * (w_b * w_b).sum()
+        alpha = _line_search_alpha(
+            pred, Xd, y, w_b, d, lambda_l1, lambda_l2
         )
-        best = jnp.argmin(obj_a)
-        alpha = jnp.where(obj_a[best] < obj_0, alphas[best], 0.0)
 
         w = jax.lax.dynamic_update_slice(w, w_b + alpha * d, (begin,))
         # incremental prediction update: pred += alpha * X_b @ d (ref: Xw)
@@ -155,12 +208,266 @@ def _objective(
     return nll + lambda_l1 * jnp.abs(w).sum() + 0.5 * lambda_l2 * (w * w).sum()
 
 
-class Darlin:
-    """Batch L1-LR solver app (scheduler role of the reference's Darlin*)."""
+# ---------------------------------------------------------------------------
+# Distributed DARLIN over the (data, kv) mesh
+#
+# Reference analog (SURVEY §3.3): workers hold example shards (their column
+# blocks + their slice of the prediction vector Xw), servers hold the weight
+# by key range. Per block: each worker computes its shard's gradient /
+# diag-Hessian contribution (push == psum over "data"), the owning server
+# range computes the proximal step, and the direction is broadcast back
+# (pull == masked psum over "kv") so every worker can update its Xw slice.
+# ---------------------------------------------------------------------------
 
-    def __init__(self, cfg: PSConfig, reporter: ProgressReporter | None = None):
+
+def shard_blocks_for_mesh(cb: ColumnBlocks, data_shards: int) -> dict:
+    """Host-side prep: partition every block's entries by example shard.
+
+    Returns numpy arrays ready for ``stack → device_put``:
+      feat_local/rows/values: (n_blocks, D, E) with rows LOCAL to the shard
+      labels/mask: (D, per) — examples padded up to a multiple of D
+    """
+    D = data_shards
+    N = cb.num_examples
+    per = -(-N // D)  # ceil: examples padded to D * per
+    shard_of_row = lambda r: r // per  # contiguous example ranges
+
+    counts = np.zeros((cb.n_blocks, D), dtype=np.int64)
+    shard_ids = []
+    for i in range(cb.n_blocks):
+        s = shard_of_row(cb.rows[i])
+        # pad entries (values == 0) all land in shard 0 — harmless, they
+        # contribute nothing to any segment sum
+        shard_ids.append(s)
+        counts[i] = np.bincount(s, minlength=D)
+    E = max(1, int(counts.max()))
+    feat = np.zeros((cb.n_blocks, D, E), dtype=cb.feat_local.dtype)
+    rows = np.zeros((cb.n_blocks, D, E), dtype=cb.rows.dtype)
+    vals = np.zeros((cb.n_blocks, D, E), dtype=cb.values.dtype)
+    for i in range(cb.n_blocks):
+        s = shard_ids[i]
+        for d in range(D):
+            m = s == d
+            k = int(m.sum())
+            feat[i, d, :k] = cb.feat_local[i][m]
+            rows[i, d, :k] = cb.rows[i][m] - d * per
+            vals[i, d, :k] = cb.values[i][m]
+    labels = np.zeros((D, per), dtype=np.float32)
+    mask = np.zeros((D, per), dtype=np.float32)
+    flat = np.asarray(cb.labels, dtype=np.float32)
+    for d in range(D):
+        lo = d * per
+        hi = min(lo + per, N)
+        if hi > lo:
+            labels[d, : hi - lo] = flat[lo:hi]
+            mask[d, : hi - lo] = 1.0
+    return {
+        "feat_local": feat, "rows": rows, "values": vals,
+        "labels": labels, "mask": mask, "per_shard_examples": per,
+    }
+
+
+def make_darlin_spmd_fns(
+    mesh,
+    *,
+    num_keys: int,
+    block_size: int,
+    per_shard_examples: int,
+    lambda_l1: float,
+    lambda_l2: float,
+    learning_rate: float,
+    delay: int,
+):
+    """Build (pass_fn, kkt_fn, objective_fn) jitted over the mesh.
+
+    Layout: w/active P("kv"); pred/labels/mask P("data", None); block entry
+    arrays P(None, "data", None). Requires num_keys divisible by kv and
+    every block wholly inside one kv range (n_blocks % kv_shards == 0 with
+    contiguous equal blocks).
+    """
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from jax import shard_map
+
+    kv = mesh.shape["kv"]
+    if num_keys % kv:
+        raise ValueError(f"num_keys {num_keys} not divisible by kv={kv}")
+    shard_size = num_keys // kv
+    if shard_size % block_size:
+        raise ValueError(
+            f"kv range {shard_size} not aligned to block_size {block_size}: "
+            "each feature block must live wholly on one kv shard"
+        )
+    per = per_shard_examples
+
+    def _bcast_from_owner(x, is_owner):
+        """Broadcast the owning kv shard's value to all (pull)."""
+        return lax.psum(jnp.where(is_owner, x, jnp.zeros_like(x)), "kv")
+
+    def _block_grad(pred_l, y_l, mask_l, fl, rows, vals):
+        p = jax.nn.sigmoid(pred_l)
+        err = (p - y_l) * mask_l
+        h_ex = p * (1.0 - p) * mask_l
+        g = jax.ops.segment_sum(
+            vals * jnp.take(err, rows), fl, num_segments=block_size
+        )
+        h = jax.ops.segment_sum(
+            vals * vals * jnp.take(h_ex, rows), fl, num_segments=block_size
+        )
+        return lax.psum(g, "data"), lax.psum(h, "data")  # push
+
+    def local_pass(w_l, pred_l, active_l, blocks_l, y_l, mask_l):
+        # squeeze this device's singleton data-axis slice
+        pred_l, y_l, mask_l = pred_l[0], y_l[0], mask_l[0]
+        my_k = lax.axis_index("kv")
+
+        def block_step(carry, blk):
+            w_l, pred_l, stale_pred, active_l, viol_max, i = carry
+            refresh = (i % (delay + 1)) == 0
+            stale_pred = jnp.where(refresh, pred_l, stale_pred)
+            fl, rows, vals = blk["feat_local"][0], blk["rows"][0], blk["values"][0]
+            b_idx = blk["block_idx"]
+            begin = b_idx * block_size
+            owner = begin // shard_size
+            is_owner = owner == my_k
+            safe_begin = jnp.where(is_owner, begin - owner * shard_size, 0)
+
+            g, h = _block_grad(stale_pred, y_l, mask_l, fl, rows, vals)
+            w_b = _bcast_from_owner(
+                lax.dynamic_slice(w_l, (safe_begin,), (block_size,)), is_owner
+            )
+            act_b = (
+                _bcast_from_owner(
+                    lax.dynamic_slice(
+                        active_l.astype(jnp.float32), (safe_begin,), (block_size,)
+                    ),
+                    is_owner,
+                )
+                > 0
+            )
+
+            viol = _kkt_viol(w_b, g, lambda_l1)
+            viol_max = jnp.maximum(viol_max, viol.max())
+            skip = (~act_b) & (w_b == 0.0)
+            d = _prox_newton_direction(
+                w_b, g, h, skip, lambda_l1, lambda_l2, learning_rate
+            )
+            # my example shard's X_b @ d; the line-search objective is the
+            # TRUE pod-wide objective (masked nll psum'd over "data")
+            Xd_l = jax.ops.segment_sum(
+                vals * jnp.take(d, fl), rows, num_segments=per
+            )
+            alpha = _line_search_alpha(
+                pred_l, Xd_l, y_l, w_b, d, lambda_l1, lambda_l2,
+                mask=mask_l, reduce=lambda x: lax.psum(x, "data"),
+            )
+
+            new_w_b = w_b + alpha * d
+            w_l = jnp.where(
+                is_owner,
+                lax.dynamic_update_slice(w_l, new_w_b, (safe_begin,)),
+                w_l,
+            )
+            pred_l = pred_l + alpha * Xd_l
+            return (w_l, pred_l, stale_pred, active_l, viol_max, i + 1), None
+
+        init = (w_l, pred_l, pred_l, active_l, jnp.float32(0.0), jnp.int32(0))
+        (w_l, pred_l, _, active_l, viol_max, _), _ = lax.scan(
+            block_step, init, blocks_l
+        )
+        return w_l, pred_l[None, :], viol_max
+
+    def local_kkt(w_l, pred_l, active_l, blocks_l, y_l, mask_l, thr):
+        """On-device KKT active-set refresh (one more gradient pass)."""
+        pred_l, y_l, mask_l = pred_l[0], y_l[0], mask_l[0]
+        my_k = lax.axis_index("kv")
+
+        def block_step(active_l, blk):
+            fl, rows, vals = blk["feat_local"][0], blk["rows"][0], blk["values"][0]
+            begin = blk["block_idx"] * block_size
+            owner = begin // shard_size
+            is_owner = owner == my_k
+            safe_begin = jnp.where(is_owner, begin - owner * shard_size, 0)
+            g, _ = _block_grad(pred_l, y_l, mask_l, fl, rows, vals)
+            w_b = _bcast_from_owner(
+                lax.dynamic_slice(w_l, (safe_begin,), (block_size,)), is_owner
+            )
+            new_act = (w_b != 0.0) | (_kkt_viol(w_b, g, lambda_l1) > thr)
+            active_l = jnp.where(
+                is_owner,
+                lax.dynamic_update_slice(active_l, new_act, (safe_begin,)),
+                active_l,
+            )
+            return active_l, None
+
+        active_l, _ = lax.scan(block_step, active_l, blocks_l)
+        return active_l
+
+    def local_obj(w_l, pred_l, y_l, mask_l):
+        pred_l, y_l, mask_l = pred_l[0], y_l[0], mask_l[0]
+        nll = lax.psum(
+            jnp.sum(mask_l * (jax.nn.softplus(pred_l) - y_l * pred_l)), "data"
+        )
+        reg = lax.psum(
+            lambda_l1 * jnp.abs(w_l).sum() + 0.5 * lambda_l2 * (w_l * w_l).sum(),
+            "kv",
+        )
+        return nll + reg
+
+    kv_s, dat, blk_s = P("kv"), P("data", None), P(None, "data", None)
+    blocks_spec = {
+        "feat_local": blk_s, "rows": blk_s, "values": blk_s, "block_idx": P(None),
+    }
+    pass_fn = jax.jit(
+        shard_map(
+            local_pass, mesh=mesh,
+            in_specs=(kv_s, dat, kv_s, blocks_spec, dat, dat),
+            out_specs=(kv_s, dat, P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1),
+    )
+    kkt_fn = jax.jit(
+        shard_map(
+            local_kkt, mesh=mesh,
+            in_specs=(kv_s, dat, kv_s, blocks_spec, dat, dat, P()),
+            out_specs=kv_s,
+            check_vma=False,
+        )
+    )
+    obj_fn = jax.jit(
+        shard_map(
+            local_obj, mesh=mesh,
+            in_specs=(kv_s, dat, dat, dat),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+    def place(name: str, arr: np.ndarray):
+        spec = {"w": kv_s, "active": kv_s, "pred": dat, "labels": dat, "mask": dat}[name]
+        return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+    return pass_fn, kkt_fn, obj_fn, place
+
+
+class Darlin:
+    """Batch L1-LR solver app (scheduler role of the reference's Darlin*).
+
+    With ``mesh`` (a (data, kv) device mesh) the solver runs distributed:
+    example shards over "data", weight ranges over "kv" — the reference's
+    worker/server split (SURVEY §3.3)."""
+
+    def __init__(
+        self,
+        cfg: PSConfig,
+        reporter: ProgressReporter | None = None,
+        mesh=None,
+    ):
         self.cfg = cfg
         self.reporter = reporter or ProgressReporter()
+        self.mesh = mesh
 
     def fit(
         self,
@@ -173,6 +480,83 @@ class Darlin:
         return self.fit_blocks(cb, shuffle_blocks=shuffle_blocks)
 
     def fit_blocks(self, cb: ColumnBlocks, shuffle_blocks: bool = True) -> dict:
+        if self.mesh is not None:
+            return self._fit_blocks_spmd(cb, shuffle_blocks=shuffle_blocks)
+        return self._fit_blocks_single(cb, shuffle_blocks=shuffle_blocks)
+
+    def _fit_blocks_spmd(self, cb: ColumnBlocks, shuffle_blocks: bool = True) -> dict:
+        """Distributed solve over the mesh (see module section above)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg = self.cfg
+        mesh = self.mesh
+        D = mesh.shape["data"]
+        sharded = shard_blocks_for_mesh(cb, D)
+        per = sharded["per_shard_examples"]
+        pass_fn, kkt_fn, obj_fn, place = make_darlin_spmd_fns(
+            mesh,
+            num_keys=cb.num_keys,
+            block_size=cb.block_size,
+            per_shard_examples=per,
+            lambda_l1=cfg.penalty.lambda_l1,
+            lambda_l2=cfg.penalty.lambda_l2,
+            learning_rate=cfg.lr.eta,
+            delay=cfg.solver.max_delay if cfg.solver.max_delay > 0 else 0,
+        )
+        w = place("w", np.zeros(cb.num_keys, np.float32))
+        active = place("active", np.ones(cb.num_keys, bool))
+        pred = place("pred", np.zeros((D, per), np.float32))
+        labels = place("labels", sharded["labels"])
+        mask = place("mask", sharded["mask"])
+        blk_sh = NamedSharding(mesh, P(None, "data", None))
+        idx_sh = NamedSharding(mesh, P(None))
+        rng = np.random.default_rng(cfg.seed)
+
+        prev_obj = float(obj_fn(w, pred, labels, mask))
+        history = []
+        for it in range(cfg.solver.block_iters):
+            order = (
+                rng.permutation(cb.n_blocks)
+                if shuffle_blocks
+                else np.arange(cb.n_blocks)
+            )
+            blocks = {
+                "feat_local": jax.device_put(sharded["feat_local"][order], blk_sh),
+                "rows": jax.device_put(sharded["rows"][order], blk_sh),
+                "values": jax.device_put(sharded["values"][order], blk_sh),
+                "block_idx": jax.device_put(order.astype(np.int32), idx_sh),
+            }
+            w, pred, viol = pass_fn(w, pred, active, blocks, labels, mask)
+            if cfg.solver.kkt_filter_threshold > 0:
+                thr = cfg.solver.kkt_filter_threshold * max(float(viol), 1e-12)
+                active = kkt_fn(
+                    w, pred, active, blocks, labels, mask, jnp.float32(thr)
+                )
+            obj = float(obj_fn(w, pred, labels, mask))
+            rel = (prev_obj - obj) / max(abs(prev_obj), 1e-12)
+            nnz = int((np.asarray(w) != 0).sum())
+            self.reporter.report(
+                examples=cb.num_examples, objv=obj / cb.num_examples,
+                nnz_w=nnz, auc=float("nan"),
+            )
+            history.append(obj)
+            if 0 <= rel < cfg.solver.epsilon and it > 0:
+                break
+            prev_obj = obj
+
+        self.w = np.asarray(w)
+        real = np.asarray(mask).ravel() > 0
+        self.pred = np.asarray(pred).ravel()[real]
+        probs = 1.0 / (1.0 + np.exp(-self.pred))
+        return {
+            "objv": history[-1] / cb.num_examples,
+            "iters": len(history),
+            "nnz_w": int((self.w != 0).sum()),
+            "train_auc": M.auc(cb.labels, probs),
+            "history": history,
+        }
+
+    def _fit_blocks_single(self, cb: ColumnBlocks, shuffle_blocks: bool = True) -> dict:
         """Run the solver on prebuilt (possibly disk-cached) column blocks."""
         cfg = self.cfg
         K, N = cb.num_keys, cb.num_examples
@@ -256,11 +640,8 @@ class Darlin:
             )
             g[i * cb.block_size : (i + 1) * cb.block_size] = np.asarray(gi)
         w_np = np.asarray(w)
-        lam = self.cfg.penalty.lambda_l1
-        viol = np.where(
-            w_np != 0.0,
-            np.abs(g + np.sign(w_np) * lam),
-            np.maximum(np.abs(g) - lam, 0.0),
+        viol = np.asarray(
+            _kkt_viol(jnp.asarray(w_np), jnp.asarray(g), self.cfg.penalty.lambda_l1)
         )
         return jnp.asarray((w_np != 0.0) | (viol > thr))
 

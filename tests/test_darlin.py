@@ -74,33 +74,36 @@ class TestColumnBlocks:
             ColumnBlocks.from_batches(data[0], NUM_KEYS, 7)
 
 
+@pytest.fixture(scope="module")
+def sklearn_ref(data):
+    """liblinear on the same objective — shared by the single-device and
+    SPMD convergence tests."""
+    from scipy.sparse import csr_matrix
+    from sklearn.linear_model import LogisticRegression
+
+    batches, labels, keys, vals = data
+    rows = np.repeat(np.arange(N), [len(k) for k in keys])
+    cols = np.concatenate(keys).astype(int) + 1  # identity mode offset
+    X = csr_matrix(
+        (np.concatenate(vals), (rows, cols)), shape=(N, NUM_KEYS)
+    )
+    lam = 1.0
+    clf = LogisticRegression(
+        penalty="l1", C=1.0 / lam, solver="liblinear", max_iter=500, tol=1e-8,
+        fit_intercept=False,
+    )
+    clf.fit(X, labels)
+    w = np.zeros(NUM_KEYS)
+    w[: clf.coef_.shape[1]] = clf.coef_[0]
+    z = X @ w
+    obj = float(
+        np.sum(np.logaddexp(0, z) - labels * z) + lam * np.abs(w).sum()
+    )
+    p = 1 / (1 + np.exp(-z))
+    return {"obj": obj, "auc": M.auc(labels, p), "nnz": (w != 0).sum(), "X": X}
+
+
 class TestDarlinConvergence:
-    @pytest.fixture(scope="class")
-    def sklearn_ref(self, data):
-        from scipy.sparse import csr_matrix
-        from sklearn.linear_model import LogisticRegression
-
-        batches, labels, keys, vals = data
-        rows = np.repeat(np.arange(N), [len(k) for k in keys])
-        cols = np.concatenate(keys).astype(int) + 1  # identity mode offset
-        X = csr_matrix(
-            (np.concatenate(vals), (rows, cols)), shape=(N, NUM_KEYS)
-        )
-        lam = 1.0
-        clf = LogisticRegression(
-            penalty="l1", C=1.0 / lam, solver="liblinear", max_iter=500, tol=1e-8,
-            fit_intercept=False,
-        )
-        clf.fit(X, labels)
-        w = np.zeros(NUM_KEYS)
-        w[: clf.coef_.shape[1]] = clf.coef_[0]
-        z = X @ w
-        obj = float(
-            np.sum(np.logaddexp(0, z) - labels * z) + lam * np.abs(w).sum()
-        )
-        p = 1 / (1 + np.exp(-z))
-        return {"obj": obj, "auc": M.auc(labels, p), "nnz": (w != 0).sum(), "X": X}
-
     def test_matches_liblinear_objective(self, data, sklearn_ref):
         batches = data[0]
         app = Darlin(make_cfg(iters=60), reporter=quiet())
@@ -144,3 +147,66 @@ class TestDarlinConvergence:
         p = app.predict(batches)
         assert p.shape == (N,)
         assert M.auc(labels, p) > 0.85
+
+
+class TestDarlinSPMD:
+    """Distributed DARLIN over the (data, kv) mesh (SURVEY §3.3: example
+    shards on workers, weight ranges on servers)."""
+
+    @pytest.mark.parametrize("mesh_shape", [(2, 2), (4, 2), (2, 4)])
+    def test_matches_single_device_trajectory(self, data, mesh_shape):
+        from parameter_server_tpu.parallel import make_mesh
+
+        batches = data[0]
+        cfg = make_cfg(iters=12)
+        ref = Darlin(cfg, reporter=quiet()).fit(batches, shuffle_blocks=False)
+        app = Darlin(cfg, reporter=quiet(), mesh=make_mesh(*mesh_shape))
+        res = app.fit(batches, shuffle_blocks=False)
+        # same math, different layout: objective trajectories must agree
+        assert len(res["history"]) == len(ref["history"])
+        np.testing.assert_allclose(
+            np.array(res["history"]), np.array(ref["history"]), rtol=2e-4
+        )
+        assert app.w.shape == (NUM_KEYS,) and app.pred.shape == (N,)
+
+    def test_shuffled_blocks_same_trajectory_as_single(self, data):
+        """Same rng seed => same block order => matching trajectories even
+        with shuffling on."""
+        from parameter_server_tpu.parallel import make_mesh
+
+        cfg = make_cfg(iters=8)
+        ref = Darlin(cfg, reporter=quiet()).fit(data[0], shuffle_blocks=True)
+        res = Darlin(cfg, reporter=quiet(), mesh=make_mesh(2, 2)).fit(
+            data[0], shuffle_blocks=True
+        )
+        np.testing.assert_allclose(
+            np.array(res["history"]), np.array(ref["history"]), rtol=2e-4
+        )
+
+    def test_kkt_on_device_converges(self, data, sklearn_ref):
+        from parameter_server_tpu.parallel import make_mesh
+
+        cfg = make_cfg(iters=60, kkt=0.1)
+        app = Darlin(cfg, reporter=quiet(), mesh=make_mesh(2, 2))
+        res = app.fit(data[0], shuffle_blocks=False)
+        assert res["history"][-1] < sklearn_ref["obj"] * 1.02
+
+    def test_bounded_delay_spmd(self, data, sklearn_ref):
+        from parameter_server_tpu.parallel import make_mesh
+
+        cfg = make_cfg(iters=60, max_delay=2)
+        res = Darlin(cfg, reporter=quiet(), mesh=make_mesh(4, 2)).fit(
+            data[0], shuffle_blocks=False
+        )
+        assert res["history"][-1] < sklearn_ref["obj"] * 1.02
+
+    def test_block_alignment_enforced(self, data):
+        from parameter_server_tpu.models.darlin import make_darlin_spmd_fns
+        from parameter_server_tpu.parallel import make_mesh
+
+        with pytest.raises(ValueError, match="aligned"):
+            make_darlin_spmd_fns(
+                make_mesh(2, 4), num_keys=NUM_KEYS, block_size=48,
+                per_shard_examples=100, lambda_l1=1.0, lambda_l2=0.0,
+                learning_rate=1.0, delay=0,
+            )
