@@ -40,6 +40,26 @@ class CSRBatch:
         return (len(self.labels), len(self.values), len(self.unique_keys))
 
 
+def training_builder(cfg, key_mode: str = "hash") -> "BatchBuilder":
+    """The training-ingest builder for a PSConfig: wires the frequency
+    filter (cfg.data.freq_min_count + [sketch] geometry) into admission.
+    Eval paths build plain BatchBuilders — unadmitted keys carry zero
+    weight, so filtering there would be pointless work."""
+    freq_filter = None
+    if cfg.data.freq_min_count > 0:
+        from parameter_server_tpu.filters.frequency import CountMinSketch
+
+        freq_filter = CountMinSketch(cfg.sketch.width, cfg.sketch.depth)
+    return BatchBuilder(
+        num_keys=cfg.data.num_keys,
+        batch_size=cfg.solver.minibatch,
+        max_nnz_per_example=cfg.data.max_nnz_per_example,
+        key_mode=key_mode,
+        freq_filter=freq_filter,
+        freq_min_count=cfg.data.freq_min_count,
+    )
+
+
 class BatchBuilder:
     """Turns parsed (label, keys, values) rows into CSRBatches.
 
@@ -56,6 +76,8 @@ class BatchBuilder:
         max_nnz_per_example: int = 256,
         unique_capacity: int | None = None,
         key_mode: str = "hash",
+        freq_filter=None,
+        freq_min_count: int = 0,
     ):
         if key_mode not in ("hash", "identity"):
             raise ValueError(f"bad key_mode {key_mode!r}")
@@ -67,6 +89,16 @@ class BatchBuilder:
             self.nnz_capacity + 1, num_keys
         )
         self.key_mode = key_mode
+        # streaming admission (ref: parameter/frequency_filter.h — only
+        # admit keys seen >= k times; at 10^9-key CTR scale the tail is
+        # noise). The sketch counts RAW pre-hash keys as they stream by;
+        # entries below the threshold are dropped before localization.
+        self.freq_filter = freq_filter
+        self.freq_min_count = freq_min_count
+        if freq_min_count > 0 and freq_filter is None:
+            from parameter_server_tpu.filters.frequency import CountMinSketch
+
+            self.freq_filter = CountMinSketch()
 
     def build(
         self,
@@ -111,6 +143,21 @@ class BatchBuilder:
         row_ids = np.repeat(
             np.arange(b, dtype=np.int32), np.diff(row_splits).astype(np.int64)
         )
+
+        if self.freq_min_count > 0 and nnz:
+            # count first, then admit: a key's nth occurrence is admitted
+            # once its running count reaches the threshold (streaming
+            # admission — early occurrences of eventually-hot keys are
+            # sacrificed, exactly the reference filter's behavior)
+            raw = np.asarray(flat_keys, dtype=np.uint64)
+            self.freq_filter.add(raw)
+            keep = self.freq_filter.admit(raw, self.freq_min_count)
+            flat_keys = raw[keep]
+            flat_vals = flat_vals[keep]
+            row_ids = row_ids[keep]
+            if flat_slots is not None:
+                flat_slots = np.asarray(flat_slots)[keep]
+            nnz = int(keep.sum())
 
         if self.key_mode == "hash":
             salts = flat_slots if flat_slots is not None else 0

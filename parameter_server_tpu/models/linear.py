@@ -114,12 +114,9 @@ class LinearMethod:
         self.examples_seen = 0
 
     def make_builder(self, key_mode: str = "hash") -> BatchBuilder:
-        return BatchBuilder(
-            num_keys=self.cfg.data.num_keys,
-            batch_size=self.cfg.solver.minibatch,
-            max_nnz_per_example=self.cfg.data.max_nnz_per_example,
-            key_mode=key_mode,
-        )
+        from parameter_server_tpu.data.batch import training_builder
+
+        return training_builder(self.cfg, key_mode)
 
     def train(
         self,

@@ -37,34 +37,103 @@ def sgns_train_step(
     B, K = negatives.shape
 
     in_rows = {k: jnp.take(v, center, axis=0) for k, v in in_state.items()}
-    u = in_up.weights(in_rows)  # (B, d)
-
     # output rows for context + negatives, flattened: (B*(1+K),)
     out_ids = jnp.concatenate([context[:, None], negatives], axis=1).reshape(-1)
     out_rows = {k: jnp.take(v, out_ids, axis=0) for k, v in out_state.items()}
-    v_all = out_up.weights(out_rows).reshape(B, 1 + K, -1)  # (B, 1+K, d)
 
-    logits = jnp.einsum("bd,bkd->bk", u, v_all)  # (B, 1+K)
-    labels = jnp.concatenate(
-        [jnp.ones((B, 1)), jnp.zeros((B, K))], axis=1
+    loss, g_u, g_v = _sgns_weights_math(
+        in_up.weights(in_rows), out_up.weights(out_rows), B, K
     )
-    # SGNS loss: -log sig(pos) - sum log sig(-neg) == softplus formulation
-    loss = jnp.sum(jax.nn.softplus(logits) - labels * logits)
-    err = jax.nn.sigmoid(logits) - labels  # (B, 1+K)
-
-    g_u = jnp.einsum("bk,bkd->bd", err, v_all)  # (B, d)
-    g_v = err[:, :, None] * u[:, None, :]  # (B, 1+K, d)
 
     d_in = in_up.delta(in_rows, g_u)
     new_in = {k: in_state[k].at[center].add(d_in[k]) for k in in_state}
     # NOTE: duplicate ids inside one batch are handled by scatter-add of
     # deltas; each occurrence computed its delta from the same pulled row —
     # the same within-step staleness semantics as the SPMD push path.
-    d_out = out_up.delta(
-        {k: v for k, v in out_rows.items()}, g_v.reshape(B * (1 + K), -1)
-    )
+    d_out = out_up.delta(out_rows, g_v)
     new_out = {k: out_state[k].at[out_ids].add(d_out[k]) for k in out_state}
     return new_in, new_out, loss
+
+
+def _sgns_weights_math(u, v_flat, B, K):
+    """SGNS loss/grads from materialized weights, shared verbatim by the
+    single-device and SPMD steps.
+
+    loss: -log sig(pos) - sum log sig(-neg), in softplus form."""
+    v_all = v_flat.reshape(B, 1 + K, -1)  # (B, 1+K, d)
+    logits = jnp.einsum("bd,bkd->bk", u, v_all)  # (B, 1+K)
+    labels = jnp.concatenate([jnp.ones((B, 1)), jnp.zeros((B, K))], axis=1)
+    loss = jnp.sum(jax.nn.softplus(logits) - labels * logits)
+    err = jax.nn.sigmoid(logits) - labels  # (B, 1+K)
+    g_u = jnp.einsum("bk,bkd->bd", err, v_all)  # (B, d)
+    g_v = (err[:, :, None] * u[:, None, :]).reshape(B * (1 + K), -1)
+    return loss, g_u, g_v
+
+
+def make_w2v_spmd_train_step(in_up: Updater, out_up: Updater, mesh, vocab_size: int):
+    """SGNS step over the (data, kv) mesh: BOTH embedding tables are
+    range-sharded over "kv" (the server tables), pair batches over "data"
+    (the workers) — same layout as the MF app (BASELINE word2vec config:
+    the classic two-huge-tables parameter-server workload)."""
+    import functools
+
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from parameter_server_tpu.parallel.spmd import (
+        _local_pull,
+        _local_push,
+        _shard_size,
+        state_spec,
+    )
+
+    shard = _shard_size(vocab_size, mesh.shape["kv"])
+
+    def local_step(in_l, out_l, batch):
+        b = {k: v[0] for k, v in batch.items()}
+        center, context, negatives = b["center"], b["context"], b["negatives"]
+        B, K = negatives.shape
+        out_ids = jnp.concatenate(
+            [context[:, None], negatives], axis=1
+        ).reshape(-1)
+        u_w = lax.psum(_local_pull(in_up, in_l, center, shard), "kv")
+        v_w = lax.psum(_local_pull(out_up, out_l, out_ids, shard), "kv")
+        loss, g_u, g_v = _sgns_weights_math(u_w, v_w, B, K)
+        new_in = _local_push(
+            in_up, in_l, lax.all_gather(center, "data"),
+            lax.all_gather(g_u, "data"), shard,
+        )
+        new_out = _local_push(
+            out_up, out_l, lax.all_gather(out_ids, "data"),
+            lax.all_gather(g_v, "data"), shard,
+        )
+        return new_in, new_out, lax.psum(loss, "data")
+
+    step = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec(), state_spec(), P("data")),
+        out_specs=(state_spec(), state_spec(), P()),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def jitted(in_state, out_state, batch):
+        return step(in_state, out_state, batch)
+
+    return jitted
+
+
+def _stack_w2v_batches(batches: list[dict], mesh) -> dict:
+    """Stack D per-worker pair batches on a leading axis, sharded over
+    "data" (negatives keep their trailing (B, K) shape)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data"))
+    return {
+        k: jax.device_put(np.stack([b[k] for b in batches]), sh)
+        for k in batches[0]
+    }
 
 
 class NegativeSampler:
@@ -91,6 +160,8 @@ class Word2Vec:
         window: int = 2,
         seed: int = 0,
         reporter: ProgressReporter | None = None,
+        mesh=None,
+        max_delay: int = 0,
     ):
         self.vocab_size = vocab_size
         self.dim = dim
@@ -99,6 +170,9 @@ class Word2Vec:
         self.reporter = reporter or ProgressReporter()
         self.in_up = Adagrad(eta=eta)
         self.out_up = Adagrad(eta=eta)
+        self.mesh = mesh
+        self.max_delay = max_delay  # SSP dispatch bound (ref: BASELINE's
+        # "bounded-staleness SSP" word2vec config)
         rng = np.random.default_rng(seed)
         self.in_state = self.in_up.init(vocab_size, dim)
         self.out_state = self.out_up.init(vocab_size, dim)
@@ -107,6 +181,14 @@ class Word2Vec:
             dtype=jnp.float32,
         )
         # output table starts at zero (standard word2vec init)
+        if mesh is not None:
+            from parameter_server_tpu.parallel.spmd import shard_state
+
+            self._spmd_step = make_w2v_spmd_train_step(
+                self.in_up, self.out_up, mesh, vocab_size
+            )
+            self.in_state = shard_state(self.in_state, mesh)
+            self.out_state = shard_state(self.out_state, mesh)
 
     def make_pairs(self, corpus: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(center, context) skip-gram pairs within the window."""
@@ -119,33 +201,72 @@ class Word2Vec:
             contexts.append(corpus[:-off])
         return np.concatenate(centers), np.concatenate(contexts)
 
+    def _make_batch(self, centers, contexts, sampler, sel) -> dict:
+        return {
+            "center": centers[sel].astype(np.int32),
+            "context": contexts[sel].astype(np.int32),
+            "negatives": sampler.sample((len(sel), self.K)).astype(np.int32),
+        }
+
     def train_epoch(
         self,
         corpus: np.ndarray,
         batch_size: int = 8192,
         seed: int = 0,
     ) -> float:
+        """One shuffled pass. Dispatch is SSP-gated: up to ``max_delay + 1``
+        steps stay in flight and losses are read back only on retirement —
+        never a per-batch device sync (the async windowed pattern of
+        models/linear.py, ref: the worker Executor's wait_time bound)."""
+        from collections import deque
+
         counts = np.bincount(corpus, minlength=self.vocab_size)
         sampler = NegativeSampler(counts, seed=seed)
         centers, contexts = self.make_pairs(corpus)
         rng = np.random.default_rng(seed)
         order = rng.permutation(len(centers))
+        D = self.mesh.shape["data"] if self.mesh is not None else 1
+        global_bs = batch_size * D
+
+        in_flight: deque = deque()  # (step, loss_array, n_pairs)
         total_loss, n = 0.0, 0
         t0 = time.perf_counter()
-        for s in range(0, len(order) - batch_size + 1, batch_size):
-            sel = order[s : s + batch_size]
-            batch = {
-                "center": jnp.asarray(centers[sel].astype(np.int32)),
-                "context": jnp.asarray(contexts[sel].astype(np.int32)),
-                "negatives": jnp.asarray(
-                    sampler.sample((len(sel), self.K)).astype(np.int32)
-                ),
-            }
-            self.in_state, self.out_state, loss = sgns_train_step(
-                self.in_up, self.out_up, self.in_state, self.out_state, batch
-            )
-            total_loss += float(loss)
+
+        def _retire(entry) -> None:
+            nonlocal total_loss
+            _, loss_arr, _cnt = entry
+            total_loss += float(loss_arr)  # sync point, bounded by the gate
+
+        step_i = 0
+        for s in range(0, len(order) - global_bs + 1, global_bs):
+            sel = order[s : s + global_bs]
+            # SSP gate: retire steps <= t - tau - 1 before dispatching t
+            target = step_i - self.max_delay - 1
+            while in_flight and in_flight[0][0] <= target:
+                _retire(in_flight.popleft())
+            if self.mesh is not None:
+                subs = [
+                    self._make_batch(
+                        centers, contexts, sampler,
+                        sel[d * batch_size : (d + 1) * batch_size],
+                    )
+                    for d in range(D)
+                ]
+                batch = _stack_w2v_batches(subs, self.mesh)
+                self.in_state, self.out_state, loss = self._spmd_step(
+                    self.in_state, self.out_state, batch
+                )
+            else:
+                b = self._make_batch(centers, contexts, sampler, sel)
+                batch = {k: jnp.asarray(v) for k, v in b.items()}
+                self.in_state, self.out_state, loss = sgns_train_step(
+                    self.in_up, self.out_up, self.in_state, self.out_state, batch
+                )
+            in_flight.append((step_i, loss, len(sel)))
             n += len(sel)
+            step_i += 1
+        while in_flight:
+            _retire(in_flight.popleft())
         mean = total_loss / max(n, 1)
         self.reporter.report(
             examples=n, objv=mean, ex_per_sec=n / max(time.perf_counter() - t0, 1e-9)

@@ -428,7 +428,6 @@ def run_worker(
     """The async-SGD worker loop over the wire (ref: AsyncSGDWorker)."""
     import jax
 
-    from parameter_server_tpu.data.batch import BatchBuilder
     from parameter_server_tpu.data.reader import MinibatchReader
     from parameter_server_tpu.models import metrics as M
     from parameter_server_tpu.ops.sparse import csr_grad, csr_logits, logistic_loss
@@ -442,11 +441,9 @@ def run_worker(
     servers = _connect_servers(ctl, rank, num_servers, cfg)
     ranges = KeyRange(0, cfg.data.num_keys).even_divide(num_servers)
     begins = np.array([r.begin for r in ranges] + [cfg.data.num_keys])
-    builder = BatchBuilder(
-        num_keys=cfg.data.num_keys,
-        batch_size=cfg.solver.minibatch,
-        max_nnz_per_example=cfg.data.max_nnz_per_example,
-    )
+    from parameter_server_tpu.data.batch import training_builder
+
+    builder = training_builder(cfg)
 
     @jax.jit
     def grad_step(w_u, values, local_ids, row_ids, labels, mask):
@@ -495,6 +492,13 @@ def run_worker(
                 "objv": sum(l for l, _, _ in window) / n,
                 "auc": M.auc(y, p),
                 "ex_per_sec": n / max(time.perf_counter() - t0, 1e-9),
+                # MEASURED wire traffic, cumulative for this worker (ref:
+                # the Postoffice per-message byte counters) — merged at the
+                # scheduler as a sum over workers
+                "wire_bytes_out": sum(sh.client.bytes_out for sh in servers)
+                + ctl.bytes_out,
+                "wire_bytes_in": sum(sh.client.bytes_in for sh in servers)
+                + ctl.bytes_in,
             },
         )
         window = []

@@ -92,6 +92,7 @@ class PodTrainer:
         mesh=None,
         reporter: ProgressReporter | None = None,
         runtime: Runtime | None = None,
+        profile_dir: str = "",
     ):
         self.cfg = cfg
         if runtime is not None:
@@ -126,14 +127,30 @@ class PodTrainer:
             num_workers=1, max_delay=max(cfg.solver.max_delay, 0)
         )
         self.examples_seen = 0
+        # observability (SURVEY §5.1): jax.profiler traces on demand + the
+        # static per-step collective-byte estimate in every report (the
+        # reference's Postoffice byte counters; reconcile the estimate
+        # against profiler-measured collective sizes on real hardware)
+        self.profile_dir = profile_dir
+        from parameter_server_tpu.parallel.traffic import linear_step_traffic
+
+        cap = min(
+            cfg.solver.minibatch * cfg.data.max_nnz_per_example + 1,
+            cfg.data.num_keys,
+        )
+        self.est_step_traffic = linear_step_traffic(
+            unique_capacity=cap,
+            vdim=1,
+            data_shards=self.data_shards,
+            kv_shards=self.mesh.shape["kv"],
+            push_mode=cfg.parallel.push_mode,
+            num_keys=cfg.data.num_keys,
+        )
 
     def _builder(self, key_mode: str) -> BatchBuilder:
-        return BatchBuilder(
-            num_keys=self.cfg.data.num_keys,
-            batch_size=self.cfg.solver.minibatch,
-            max_nnz_per_example=self.cfg.data.max_nnz_per_example,
-            key_mode=key_mode,
-        )
+        from parameter_server_tpu.data.batch import training_builder
+
+        return training_builder(self.cfg, key_mode)
 
     def train_files(
         self,
@@ -142,6 +159,17 @@ class PodTrainer:
         report_every: int = 20,
     ) -> dict:
         """Run all epochs over ``files`` sharded across workers."""
+        import contextlib
+
+        trace_cm = (
+            jax.profiler.trace(self.profile_dir)
+            if self.profile_dir
+            else contextlib.nullcontext()
+        )
+        with trace_cm:
+            return self._run_epochs(files, key_mode, report_every)
+
+    def _run_epochs(self, files, key_mode, report_every) -> dict:
         cfg = self.cfg
         last: dict = {}
         for _ in range(max(1, cfg.solver.epochs)):
@@ -239,6 +267,10 @@ class PodTrainer:
             auc=M.auc(y, p) if len(y) else float("nan"),
             ex_per_sec=n_since / max(time.perf_counter() - t0, 1e-9),
             ssp=self.clock.progress(),
+            # static per-device collective estimate for this window (ref:
+            # Postoffice byte counters; see traffic.py)
+            est_collective_bytes=self.est_step_traffic.total_bytes
+            * len(window),
         )
 
     def full_weights(self) -> np.ndarray:

@@ -26,6 +26,12 @@ class DataConfig:
     val_files: list[str] = field(default_factory=list)
     max_nnz_per_example: int = 512
     cache_dir: str = ""  # columnar block cache (ref: SlotReader cache)
+    # frequency-filter admission (ref: parameter/frequency_filter.h): only
+    # keys seen >= this many times enter batches; 0 disables. Sketch
+    # geometry comes from the [sketch] section. Applies to the streaming
+    # (SGD/FTRL) ingest; eval always sees all keys (unadmitted ones simply
+    # carry zero weight).
+    freq_min_count: int = 0
 
 
 @dataclass

@@ -107,7 +107,15 @@ def merge_progress(reports: list[dict[str, Any]]) -> dict[str, Any]:
                 out[k] = sum(x * w for x, w in pairs) / tot
             else:  # any report without a count: fall back to unweighted mean
                 out[k] = sum(x for x, _ in pairs) / len(pairs)
-    for k in ("nnz_w", "ex_per_sec", "bytes_pushed", "bytes_pulled"):
+    for k in (
+        "nnz_w",
+        "ex_per_sec",
+        "bytes_pushed",
+        "bytes_pulled",
+        "wire_bytes_out",
+        "wire_bytes_in",
+        "est_collective_bytes",
+    ):
         vals = [r[k] for r in reports if k in r]
         if vals:
             out[k] = sum(vals)

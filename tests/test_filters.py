@@ -137,3 +137,94 @@ class TestTraffic:
     def test_quantization_savings(self):
         assert quantization_savings(1) == 0.75
         assert quantization_savings(2) == 0.5
+
+
+class TestFrequencyFilterIngest:
+    """The admission path (ref: frequency_filter.h wired into ingest):
+    keys below the count threshold never enter batches."""
+
+    def test_streaming_admission_across_batches(self):
+        from parameter_server_tpu.data.batch import BatchBuilder
+
+        builder = BatchBuilder(
+            num_keys=1 << 12, batch_size=4, key_mode="identity",
+            freq_min_count=2,
+        )
+        keys = [np.array([7, 8], dtype=np.uint64)]
+        vals = [np.ones(2, dtype=np.float32)]
+        b1 = builder.build(np.ones(1, dtype=np.float32), keys, vals)
+        assert b1.num_entries == 0  # first sighting: below threshold
+        b2 = builder.build(np.ones(1, dtype=np.float32), keys, vals)
+        assert b2.num_entries == 2  # second sighting reaches the count
+
+    def test_within_batch_repeats_admit(self):
+        from parameter_server_tpu.data.batch import BatchBuilder
+
+        builder = BatchBuilder(
+            num_keys=1 << 12, batch_size=4, key_mode="identity",
+            freq_min_count=2,
+        )
+        # key 5 twice in one batch -> counted to 2 before admission
+        b = builder.build(
+            np.ones(2, dtype=np.float32),
+            [np.array([5], dtype=np.uint64), np.array([5], dtype=np.uint64)],
+            [np.ones(1, dtype=np.float32)] * 2,
+        )
+        assert b.num_entries == 2
+
+    def test_tail_gets_no_weight_auc_preserved(self):
+        """Heavy-tail synthetic: signal lives in 40 head keys; every example
+        also carries a unique tail key (pure noise). With admission, tail
+        rows must stay exactly zero and AUC must not degrade."""
+        from parameter_server_tpu.data.synthetic import make_sparse_logistic
+        from parameter_server_tpu.models.linear import LinearMethod
+        from parameter_server_tpu.utils.config import PSConfig
+
+        n_all, n, n_head = 3600, 3000, 40
+        labels, keys, vals, _ = make_sparse_logistic(
+            n_all, n_head, nnz_per_example=6, noise=0.3, seed=3
+        )
+        keys = [
+            np.concatenate([k, [np.uint64(n_head + 2 + i)]]).astype(np.uint64)
+            for i, k in enumerate(keys)
+        ]
+        vals = [np.concatenate([v, [1.0]]).astype(np.float32) for v in vals]
+
+        def run(min_count):
+            cfg = PSConfig()
+            cfg.data.num_keys = 1 << 13
+            cfg.solver.minibatch = 256
+            cfg.solver.algo = "ftrl"
+            cfg.penalty.lambda_l1 = 0.001
+            cfg.data.freq_min_count = min_count
+            app = LinearMethod(cfg)
+            builder = app.make_builder("identity")
+            for ep in range(3):
+                batches = [
+                    builder.build(
+                        labels[s : s + 256], keys[s : s + 256], vals[s : s + 256]
+                    )
+                    for s in range(0, n, 256)
+                ]
+                app.train(batches, report_every=10**9)
+            w = np.asarray(app.store.weights())[:, 0]
+            # held-out eval through an UNFILTERED builder (eval sees every
+            # key; unadmitted ones carry zero weight anyway)
+            ev_builder = LinearMethod(cfg).make_builder("identity")
+            ev_builder.freq_min_count = 0
+            ev = app.evaluate(
+                ev_builder.build(
+                    labels[s : s + 200], keys[s : s + 200], vals[s : s + 200]
+                )
+                for s in range(n, n_all, 200)
+            )
+            return w, ev["auc"]
+
+        # 3 epochs give every tail key a streaming count of 3; the
+        # threshold must exceed that to keep them out for the whole run
+        w_filt, auc_filt = run(min_count=5)
+        w_raw, auc_raw = run(min_count=0)
+        tail_rows = np.arange(n_head + 2, n_head + 2 + n) + 1  # identity +1
+        assert np.all(w_filt[tail_rows] == 0.0), "tail keys got weight"
+        assert np.count_nonzero(w_raw[tail_rows]) > 0  # unfiltered does
+        assert auc_filt > auc_raw - 0.02, (auc_filt, auc_raw)

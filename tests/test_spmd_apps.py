@@ -103,3 +103,33 @@ class TestMFSPMD:
                 first = float(loss)
             last = float(loss)
         assert last < first * 0.3, (first, last)
+
+
+class TestWord2VecSPMD:
+    def test_learns_structure_on_mesh(self):
+        """BASELINE's word2vec config on the mesh: both embedding tables
+        range-sharded over kv, pair batches over data, SSP-gated dispatch
+        (max_delay=1) with no per-batch device sync."""
+        from parameter_server_tpu.models.word2vec import Word2Vec
+
+        mesh = make_mesh(2, 4)
+        rng = np.random.default_rng(0)
+        chunks = []
+        for _ in range(600):
+            topic = rng.integers(0, 2)
+            chunks.append(rng.integers(0, 5, size=8) + 5 * topic)
+        corpus = np.concatenate(chunks)
+        # vocab padded to 16 (divisible by the kv axis); rows 10-15 unused.
+        # batch_size is per data shard — the same 2048 the single-device
+        # test converges with (smaller per-push batches decay Adagrad's
+        # effective lr too fast on this tiny corpus)
+        w2v = Word2Vec(vocab_size=16, dim=16, eta=0.5, num_negatives=4,
+                       window=2, reporter=quiet(), mesh=mesh, max_delay=1)
+        losses = [
+            w2v.train_epoch(corpus, batch_size=2048, seed=ep)
+            for ep in range(8)
+        ]
+        assert losses[-1] < losses[0]
+        within = np.mean([w2v.similarity(0, i) for i in range(1, 5)])
+        across = np.mean([w2v.similarity(0, i) for i in range(5, 10)])
+        assert within > across + 0.3, (within, across)
