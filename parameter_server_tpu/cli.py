@@ -27,7 +27,11 @@ def _build_parser() -> argparse.ArgumentParser:
     tr = sub.add_parser("train", help="train the configured app")
     tr.add_argument("--app_file", required=True, help="JSON/TOML PSConfig")
     tr.add_argument("--model_out", default="", help="text model dump path")
-    tr.add_argument("--ckpt_dir", default="", help="checkpoint directory")
+    tr.add_argument(
+        "--ckpt_dir", default="",
+        help="checkpoint directory (multi-host: pass the SAME flags on "
+        "every host — saving ends in a cross-host barrier)",
+    )
     tr.add_argument("--resume", action="store_true", help="resume from ckpt_dir")
     tr.add_argument(
         "--report_interval", type=int, default=50, help="steps between reports"
@@ -210,10 +214,11 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
     if args.model_out:
         app.dump_model(args.model_out)
     if cfg.data.val_files:
+        from parameter_server_tpu.data.batch import eval_builder
         from parameter_server_tpu.data.reader import MinibatchReader
 
         ev = app.evaluate(
-            MinibatchReader(cfg.data.val_files, cfg.data.format, app.make_builder())
+            MinibatchReader(cfg.data.val_files, cfg.data.format, eval_builder(cfg))
         )
         last = {**last, **{f"val_{k}": v for k, v in ev.items()}}
     return last

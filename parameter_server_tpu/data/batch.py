@@ -60,6 +60,20 @@ def training_builder(cfg, key_mode: str = "hash") -> "BatchBuilder":
     )
 
 
+def eval_builder(cfg, key_mode: str = "hash") -> "BatchBuilder":
+    """The evaluation-ingest builder: NO frequency admission. A fresh
+    filter would restart every key at count 0 and silently drop entries
+    for keys the model actually trained on, skewing val metrics; and
+    unadmitted keys carry zero weight anyway, so filtering eval input is
+    pointless work either way."""
+    return BatchBuilder(
+        num_keys=cfg.data.num_keys,
+        batch_size=cfg.solver.minibatch,
+        max_nnz_per_example=cfg.data.max_nnz_per_example,
+        key_mode=key_mode,
+    )
+
+
 class BatchBuilder:
     """Turns parsed (label, keys, values) rows into CSRBatches.
 
@@ -145,10 +159,12 @@ class BatchBuilder:
         )
 
         if self.freq_min_count > 0 and nnz:
-            # count first, then admit: a key's nth occurrence is admitted
-            # once its running count reaches the threshold (streaming
-            # admission — early occurrences of eventually-hot keys are
-            # sacrificed, exactly the reference filter's behavior)
+            # count first (whole batch), then admit: a key is admitted —
+            # including all its occurrences WITHIN this batch — once its
+            # running count crosses the threshold. Admission is
+            # batch-granular, not per-occurrence; occurrences in batches
+            # before the crossing are sacrificed (the tail-filtering the
+            # reference's frequency filter exists for)
             raw = np.asarray(flat_keys, dtype=np.uint64)
             self.freq_filter.add(raw)
             keep = self.freq_filter.admit(raw, self.freq_min_count)

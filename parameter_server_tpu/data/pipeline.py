@@ -1,0 +1,158 @@
+"""Parallel prefetching host input pipeline.
+
+Reference analog: learner/sgd.h — each SGD worker runs a parser thread
+feeding a threadsafe minibatch queue so gradient compute never waits on
+text parsing (SURVEY §2.2 threading/queues, §7.4 "the C++ parser must
+sustain ≥ GB/s/host"). That feed structure is what keeps reference
+workers busy; this module is its pod analog.
+
+Topology: D builder threads (one per worker stream, each owning its own
+stateful BatchBuilder so admission filters stay single-threaded) push
+per-worker batches into per-stream bounded queues; one stacker thread
+assembles them into ready global step items — stacked arrays plus the
+host-side bookkeeping (example counts, labels) — in a bounded output
+queue. The dispatch loop then only pops + dispatches the device step,
+overlapping host parse/build with device compute instead of serializing
+D batch builds inline before every step.
+
+Draining contract: ``get()`` returns ``None`` once every stream is
+exhausted (and forever after). Callers that must keep issuing collectives
+(multi-host SPMD: every process runs the same program) substitute their
+own inert batches after that.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections.abc import Callable, Sequence
+from typing import Any
+
+_END = object()
+
+
+class PrefetchPipeline:
+    """Bounded parallel producer of ready-to-dispatch global step items.
+
+    streams: objects exposing ``next_batch() -> batch | None`` (None =
+        drained) and ``_empty() -> batch`` (inert all-padding batch).
+    prepare: ``prepare(batches: list) -> item`` run on the stacker thread —
+        the per-step host work (stacking, label bookkeeping) moved off the
+        dispatch loop.
+    depth: bound of every internal queue (per-stream and output).
+    """
+
+    def __init__(
+        self,
+        streams: Sequence[Any],
+        prepare: Callable[[list], Any],
+        depth: int = 2,
+    ):
+        if depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        self.streams = list(streams)
+        self.prepare = prepare
+        self._qs = [queue.Queue(maxsize=depth) for _ in self.streams]
+        self._out: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._errs: list[BaseException] = []
+        self._drained = False
+        self._threads = [
+            threading.Thread(target=self._produce, args=(i,), daemon=True)
+            for i in range(len(self.streams))
+        ]
+        self._threads.append(
+            threading.Thread(target=self._stack_loop, daemon=True)
+        )
+        for t in self._threads:
+            t.start()
+
+    # -- queue helpers that respect shutdown ------------------------------
+    def _put(self, q: queue.Queue, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _get(self, q: queue.Queue):
+        while not self._stop.is_set():
+            try:
+                return q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+        return _END
+
+    # -- threads -----------------------------------------------------------
+    def _produce(self, i: int) -> None:
+        try:
+            while not self._stop.is_set():
+                b = self.streams[i].next_batch()
+                if b is None:
+                    break
+                if not self._put(self._qs[i], b):
+                    return
+        except BaseException as e:  # re-raised on the consumer side
+            self._errs.append(e)
+        finally:
+            self._put(self._qs[i], _END)
+
+    def _stack_loop(self) -> None:
+        done = [False] * len(self.streams)
+        try:
+            while not self._stop.is_set():
+                batches = []
+                for i, q in enumerate(self._qs):
+                    if done[i]:
+                        batches.append(self.streams[i]._empty())
+                        continue
+                    item = self._get(q)
+                    if item is _END:
+                        done[i] = True
+                        batches.append(self.streams[i]._empty())
+                    else:
+                        batches.append(item)
+                if all(done):
+                    break
+                if not self._put(self._out, self.prepare(batches)):
+                    return
+        except BaseException as e:
+            self._errs.append(e)
+        finally:
+            self._put(self._out, _END)
+
+    # -- consumer API ------------------------------------------------------
+    def get(self):
+        """Next ready step item; None once (and forever after) every
+        stream has drained. Producer-thread exceptions re-raise here."""
+        if self._errs:
+            self._stop.set()
+            raise self._errs[0]
+        if self._drained:
+            return None
+        item = self._out.get()
+        if item is _END:
+            self._drained = True
+            if self._errs:
+                raise self._errs[0]
+            return None
+        return item
+
+    def close(self) -> None:
+        """Unstick and retire all threads (safe to call twice)."""
+        self._stop.set()
+        for q in [*self._qs, self._out]:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def __enter__(self) -> "PrefetchPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -26,6 +26,7 @@ import jax
 import numpy as np
 
 from parameter_server_tpu.data.batch import BatchBuilder, CSRBatch
+from parameter_server_tpu.data.pipeline import PrefetchPipeline
 from parameter_server_tpu.data.reader import MinibatchReader
 from parameter_server_tpu.models import metrics as M
 from parameter_server_tpu.models.linear import updater_from_config
@@ -185,6 +186,16 @@ class PodTrainer:
             last = self._train_epoch(streams, report_every) or last
         return last
 
+    @staticmethod
+    def _prepare(batches: list[CSRBatch]) -> tuple:
+        """Per-step host work: stack D per-worker batches + bookkeeping.
+        Runs on the pipeline's stacker thread (or inline when serial)."""
+        stacked = stack_batches(batches, None)
+        n = sum(b.num_examples for b in batches)
+        labels = np.concatenate([b.labels[: b.num_examples] for b in batches])
+        counts = [b.num_examples for b in batches]
+        return stacked, n, labels, counts
+
     def _train_epoch(self, streams: list[_WorkerStream], report_every: int) -> dict:
         in_flight: deque = deque()  # (step, loss, examples, probs, labels, n)
         window: list = []
@@ -205,6 +216,39 @@ class PodTrainer:
                 (float(loss_arr), self.runtime.localize_data(probs), labels)
             )
 
+        # Host input pipeline (ref: learner/sgd.h parser threads): batch
+        # builds run on background threads; the loop below only pops
+        # ready-stacked step items and dispatches the device step.
+        depth = self.cfg.data.pipeline_depth
+        pipeline = (
+            PrefetchPipeline(streams, self._prepare, depth=depth)
+            if depth > 0
+            else None
+        )
+        empty_item = None  # lazily-built inert step item for drained hosts
+
+        def _next_item():
+            nonlocal empty_item
+            item = None
+            if pipeline is not None:
+                item = pipeline.get()
+            else:
+                batches = [s.next_batch() for s in streams]
+                if any(b is not None for b in batches):
+                    item = self._prepare(
+                        [
+                            b if b is not None else streams[i]._empty()
+                            for i, b in enumerate(batches)
+                        ]
+                    )
+            if item is None:
+                # drained locally: keep issuing inert steps so every host
+                # runs the same collectives until the pod-wide count hits 0
+                if empty_item is None:
+                    empty_item = self._prepare([s._empty() for s in streams])
+                item = empty_item
+            return item
+
         # Termination contract (multi-host safe): a host whose local
         # streams dry up keeps issuing steps with all-empty batches — every
         # process must issue the same collectives — and ALL hosts stop
@@ -212,41 +256,36 @@ class PodTrainer:
         # (psum'd inside the step) is zero. The SSP gate's retirement
         # schedule is deterministic, so every host stops at the same step
         # index with no blocking host-side barrier on the dispatch path.
-        while True:
-            # SSP gate: block until step (t - tau - 1) has fully completed
-            target = step_idx - self.clock.max_delay - 1
-            while in_flight and in_flight[0][0] <= target:
-                _retire(in_flight.popleft())
-            if drained:
-                break
-            batches = [s.next_batch() for s in streams]
-            batches = [
-                b if b is not None else streams[i]._empty()
-                for i, b in enumerate(batches)
-            ]
-            stacked = self.runtime.globalize_batch(stack_batches(batches, None))
-            self.state, out = self.step_fn(self.state, stacked)
-            n = sum(b.num_examples for b in batches)
-            self.examples_seen += n
-            n_since += n
-            labels = np.concatenate(
-                [b.labels[: b.num_examples] for b in batches]
-            )
-            mask_counts = [b.num_examples for b in batches]
-            in_flight.append(
-                (
-                    step_idx, out["loss_sum"], out["examples"], out["probs"],
-                    (labels, mask_counts), n,
-                )
-            )
-            step_idx += 1
-            if step_idx % report_every == 0:
-                while in_flight:
+        try:
+            while True:
+                # SSP gate: block until step (t - tau - 1) fully completed
+                target = step_idx - self.clock.max_delay - 1
+                while in_flight and in_flight[0][0] <= target:
                     _retire(in_flight.popleft())
-                last = self._flush(window, n_since, t0)
-                window, n_since, t0 = [], 0, time.perf_counter()
-        while in_flight:
-            _retire(in_flight.popleft())
+                if drained:
+                    break
+                stacked_np, n, labels, mask_counts = _next_item()
+                stacked = self.runtime.globalize_batch(stacked_np)
+                self.state, out = self.step_fn(self.state, stacked)
+                self.examples_seen += n
+                n_since += n
+                in_flight.append(
+                    (
+                        step_idx, out["loss_sum"], out["examples"],
+                        out["probs"], (labels, mask_counts), n,
+                    )
+                )
+                step_idx += 1
+                if step_idx % report_every == 0:
+                    while in_flight:
+                        _retire(in_flight.popleft())
+                    last = self._flush(window, n_since, t0)
+                    window, n_since, t0 = [], 0, time.perf_counter()
+            while in_flight:
+                _retire(in_flight.popleft())
+        finally:
+            if pipeline is not None:
+                pipeline.close()
         if n_since:
             last = self._flush(window, n_since, t0)
         return last
@@ -285,7 +324,12 @@ class PodTrainer:
 
     def save(self, ckpt_dir, meta: dict | None = None) -> None:
         """Per-host sharded checkpoint (each host writes its key-range
-        slice; ref: each server dumps its own range)."""
+        slice; ref: each server dumps its own range).
+
+        Multi-host contract: ``save`` ends in a cross-host barrier, so
+        EVERY process must call it with the same decision to save — run
+        the identical CLI flags (--ckpt_dir in particular) on all hosts,
+        or a saving host deadlocks waiting on one that skipped it."""
         self.runtime.save_checkpoint(
             ckpt_dir,
             self.state,
@@ -316,7 +360,9 @@ class PodTrainer:
                 max_nnz_per_example=self.cfg.data.max_nnz_per_example,
                 key_mode=key_mode,
             )
-        builder = self._builder(key_mode)
+        from parameter_server_tpu.data.batch import eval_builder
+
+        builder = eval_builder(self.cfg, key_mode)
         reader = MinibatchReader(files, self.cfg.data.format, builder)
         ys, ps = [], []
         for b in reader:

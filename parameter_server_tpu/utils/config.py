@@ -32,6 +32,10 @@ class DataConfig:
     # (SGD/FTRL) ingest; eval always sees all keys (unadmitted ones simply
     # carry zero weight).
     freq_min_count: int = 0
+    # host input pipeline depth (ref: learner/sgd.h parser threads +
+    # threadsafe queues): bound of the prefetch queues feeding the SPMD
+    # dispatch loop; 0 builds batches serially inline (debugging)
+    pipeline_depth: int = 2
 
 
 @dataclass

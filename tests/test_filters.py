@@ -210,8 +210,9 @@ class TestFrequencyFilterIngest:
             w = np.asarray(app.store.weights())[:, 0]
             # held-out eval through an UNFILTERED builder (eval sees every
             # key; unadmitted ones carry zero weight anyway)
-            ev_builder = LinearMethod(cfg).make_builder("identity")
-            ev_builder.freq_min_count = 0
+            from parameter_server_tpu.data.batch import eval_builder
+
+            ev_builder = eval_builder(cfg, "identity")
             ev = app.evaluate(
                 ev_builder.build(
                     labels[s : s + 200], keys[s : s + 200], vals[s : s + 200]
