@@ -143,10 +143,16 @@ def make_mf_spmd_train_step(
     num_user_rows: int,
     num_item_rows: int,
     l2: float,
+    push_mode: str = "per_worker",
 ):
     """Multi-device MF step: user and item factor tables range-sharded over
     the ``kv`` mesh axis, rating batches over ``data`` (the reference's MF
-    app topology: rating blocks on workers, factors on servers)."""
+    app topology: rating blocks on workers, factors on servers).
+
+    push_mode "aggregate": pre-sum per-key factor grads across data shards
+    with one psum per table and apply ONE updater step (see
+    parallel/spmd._local_push_aggregate — exactly equal to per_worker for
+    plain SGD, standard sync aggregation for AdaGrad)."""
 
     from jax import lax, shard_map
     from jax.sharding import PartitionSpec as P
@@ -154,11 +160,14 @@ def make_mf_spmd_train_step(
     from parameter_server_tpu.parallel.spmd import (
         _local_pull,
         _local_push,
+        _local_push_aggregate,
         _shard_size,
         batch_spec,
         state_spec,
     )
 
+    if push_mode not in ("per_worker", "aggregate"):
+        raise ValueError(f"unknown push_mode {push_mode!r}")
     u_shard = _shard_size(num_user_rows, mesh.shape["kv"])
     i_shard = _shard_size(num_item_rows, mesh.shape["kv"])
 
@@ -168,14 +177,18 @@ def make_mf_spmd_train_step(
         U = lax.psum(_local_pull(user_up, user_l, uk, u_shard), "kv")
         V = lax.psum(_local_pull(item_up, item_l, ik, i_shard), "kv")
         loss, g_u, g_v = _mf_loss_and_grads(U, V, b, l2)
-        new_user = _local_push(
-            user_up, user_l, lax.all_gather(uk, "data"),
-            lax.all_gather(g_u, "data"), u_shard,
-        )
-        new_item = _local_push(
-            item_up, item_l, lax.all_gather(ik, "data"),
-            lax.all_gather(g_v, "data"), i_shard,
-        )
+        if push_mode == "aggregate":
+            new_user = _local_push_aggregate(user_up, user_l, uk, g_u, u_shard)
+            new_item = _local_push_aggregate(item_up, item_l, ik, g_v, i_shard)
+        else:
+            new_user = _local_push(
+                user_up, user_l, lax.all_gather(uk, "data"),
+                lax.all_gather(g_u, "data"), u_shard,
+            )
+            new_item = _local_push(
+                item_up, item_l, lax.all_gather(ik, "data"),
+                lax.all_gather(g_v, "data"), i_shard,
+            )
         return new_user, new_item, lax.psum(loss, "data")
 
     step = shard_map(

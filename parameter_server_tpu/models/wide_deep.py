@@ -128,6 +128,7 @@ def make_wd_spmd_train_step(
     opt: Any,
     mesh,
     num_keys: int,
+    push_mode: str = "per_worker",
 ):
     """Multi-device Wide&Deep step: both KV tables range-sharded over the
     ``kv`` mesh axis (BASELINE.json: "server-sharded embeddings"), batches
@@ -135,7 +136,10 @@ def make_wd_spmd_train_step(
 
     Same wire pattern as the linear SPMD step (parallel/spmd.py): pull =
     masked gather + psum over kv; push = all_gather over data + sequential
-    per-worker updates on each kv shard."""
+    per-worker updates on each kv shard — or, with push_mode "aggregate",
+    one psum per table pre-sums the per-key grads and ONE updater step
+    applies them (parallel/spmd._local_push_aggregate; the embedding-table
+    push is this app's dominant traffic)."""
 
     from jax import lax, shard_map
     from jax.sharding import PartitionSpec as P
@@ -143,11 +147,14 @@ def make_wd_spmd_train_step(
     from parameter_server_tpu.parallel.spmd import (
         _local_pull,
         _local_push,
+        _local_push_aggregate,
         _shard_size,
         batch_spec,
         state_spec,
     )
 
+    if push_mode not in ("per_worker", "aggregate"):
+        raise ValueError(f"unknown push_mode {push_mode!r}")
     shard_size = _shard_size(num_keys, mesh.shape["kv"])
 
     def local_step(wide_l, emb_l, mlp_params, opt_state, batch):
@@ -158,13 +165,23 @@ def make_wd_spmd_train_step(
 
         loss, logits, (g_wide, g_emb, g_mlp) = _wd_grads(w_u, e_u, mlp_params, b)
 
-        all_idx = lax.all_gather(idx, "data")
-        new_wide = _local_push(
-            wide_up, wide_l, all_idx, lax.all_gather(g_wide, "data"), shard_size
-        )
-        new_emb = _local_push(
-            emb_up, emb_l, all_idx, lax.all_gather(g_emb, "data"), shard_size
-        )
+        if push_mode == "aggregate":
+            new_wide = _local_push_aggregate(
+                wide_up, wide_l, idx, g_wide, shard_size
+            )
+            new_emb = _local_push_aggregate(
+                emb_up, emb_l, idx, g_emb, shard_size
+            )
+        else:
+            all_idx = lax.all_gather(idx, "data")
+            new_wide = _local_push(
+                wide_up, wide_l, all_idx, lax.all_gather(g_wide, "data"),
+                shard_size,
+            )
+            new_emb = _local_push(
+                emb_up, emb_l, all_idx, lax.all_gather(g_emb, "data"),
+                shard_size,
+            )
         g_mlp = jax.tree.map(lambda g: lax.psum(g, "data"), g_mlp)
         new_mlp, new_opt_state = _mlp_update(opt, g_mlp, opt_state, mlp_params)
         loss_sum = lax.psum(loss, "data")

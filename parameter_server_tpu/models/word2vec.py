@@ -70,11 +70,21 @@ def _sgns_weights_math(u, v_flat, B, K):
     return loss, g_u, g_v
 
 
-def make_w2v_spmd_train_step(in_up: Updater, out_up: Updater, mesh, vocab_size: int):
+def make_w2v_spmd_train_step(
+    in_up: Updater, out_up: Updater, mesh, vocab_size: int,
+    push_mode: str = "per_worker",
+):
     """SGNS step over the (data, kv) mesh: BOTH embedding tables are
     range-sharded over "kv" (the server tables), pair batches over "data"
     (the workers) — same layout as the MF app (BASELINE word2vec config:
-    the classic two-huge-tables parameter-server workload)."""
+    the classic two-huge-tables parameter-server workload).
+
+    push_mode "aggregate" pre-sums per-key grads across data shards with
+    one psum per table and applies ONE updater step (the north star's
+    "push ≡ reduce-scatter") — the win matters most here, where the
+    (B·(1+K), dim) output-table push makes the all-gather the most
+    expensive part of the per_worker path. Standard sync aggregation for
+    AdaGrad (same fixed point, different trajectory)."""
     import functools
 
     from jax import lax, shard_map
@@ -83,10 +93,13 @@ def make_w2v_spmd_train_step(in_up: Updater, out_up: Updater, mesh, vocab_size: 
     from parameter_server_tpu.parallel.spmd import (
         _local_pull,
         _local_push,
+        _local_push_aggregate,
         _shard_size,
         state_spec,
     )
 
+    if push_mode not in ("per_worker", "aggregate"):
+        raise ValueError(f"unknown push_mode {push_mode!r}")
     shard = _shard_size(vocab_size, mesh.shape["kv"])
 
     def local_step(in_l, out_l, batch):
@@ -99,14 +112,18 @@ def make_w2v_spmd_train_step(in_up: Updater, out_up: Updater, mesh, vocab_size: 
         u_w = lax.psum(_local_pull(in_up, in_l, center, shard), "kv")
         v_w = lax.psum(_local_pull(out_up, out_l, out_ids, shard), "kv")
         loss, g_u, g_v = _sgns_weights_math(u_w, v_w, B, K)
-        new_in = _local_push(
-            in_up, in_l, lax.all_gather(center, "data"),
-            lax.all_gather(g_u, "data"), shard,
-        )
-        new_out = _local_push(
-            out_up, out_l, lax.all_gather(out_ids, "data"),
-            lax.all_gather(g_v, "data"), shard,
-        )
+        if push_mode == "aggregate":
+            new_in = _local_push_aggregate(in_up, in_l, center, g_u, shard)
+            new_out = _local_push_aggregate(out_up, out_l, out_ids, g_v, shard)
+        else:
+            new_in = _local_push(
+                in_up, in_l, lax.all_gather(center, "data"),
+                lax.all_gather(g_u, "data"), shard,
+            )
+            new_out = _local_push(
+                out_up, out_l, lax.all_gather(out_ids, "data"),
+                lax.all_gather(g_v, "data"), shard,
+            )
         return new_in, new_out, lax.psum(loss, "data")
 
     step = shard_map(
@@ -162,6 +179,7 @@ class Word2Vec:
         reporter: ProgressReporter | None = None,
         mesh=None,
         max_delay: int = 0,
+        push_mode: str = "per_worker",
     ):
         self.vocab_size = vocab_size
         self.dim = dim
@@ -185,7 +203,7 @@ class Word2Vec:
             from parameter_server_tpu.parallel.spmd import shard_state
 
             self._spmd_step = make_w2v_spmd_train_step(
-                self.in_up, self.out_up, mesh, vocab_size
+                self.in_up, self.out_up, mesh, vocab_size, push_mode=push_mode
             )
             self.in_state = shard_state(self.in_state, mesh)
             self.out_state = shard_state(self.out_state, mesh)

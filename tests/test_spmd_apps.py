@@ -105,11 +105,115 @@ class TestMFSPMD:
         assert last < first * 0.3, (first, last)
 
 
+class TestMFAggregatePush:
+    def _data(self, n_u=96, n_i=64, rank=4, n=3000, seed=0):
+        rng = np.random.default_rng(seed)
+        U = rng.normal(size=(n_u, rank)) / np.sqrt(rank)
+        V = rng.normal(size=(n_i, rank)) / np.sqrt(rank)
+        us = rng.integers(0, n_u - 1, n)
+        it = rng.integers(0, n_i - 1, n)
+        r = (np.sum(U[us] * V[it], 1) + 0.05 * rng.normal(size=n)).astype(
+            np.float32
+        )
+        return us, it, r
+
+    def test_aggregate_equals_per_worker_for_sgd(self):
+        """Plain SGD deltas are linear in the gradient, so pre-summing
+        across data shards (one psum) must reproduce the sequential
+        per-worker scan exactly (same claim the linear app's aggregate
+        mode is property-tested on)."""
+        mesh = make_mesh(2, 4)
+        n_u, n_i = 96, 64
+        us, it, r = self._data(n_u, n_i)
+        builder = MFBatchBuilder(batch_size=750)
+        finals = {}
+        for mode in ("per_worker", "aggregate"):
+            app = MatrixFactorization(n_u - 1, n_i - 1, rank=8, eta=0.05,
+                                      l2=0.002, algo="sgd", reporter=quiet())
+            step = make_mf_spmd_train_step(
+                app.user_up, app.item_up, mesh, n_u, n_i, l2=0.002,
+                push_mode=mode,
+            )
+            user = shard_state(app.user_state, mesh)
+            item = shard_state(app.item_state, mesh)
+            for s in range(0, 3000, 1500):
+                bs = [
+                    builder.build(
+                        us[s + i : s + 1500 : 2],
+                        it[s + i : s + 1500 : 2],
+                        r[s + i : s + 1500 : 2],
+                    )
+                    for i in range(2)
+                ]
+                user, item, _ = step(user, item, stack_mf_batches(bs, mesh))
+            finals[mode] = (
+                np.asarray(jax.device_get(user["w"])),
+                np.asarray(jax.device_get(item["w"])),
+            )
+        for a, b in zip(finals["per_worker"], finals["aggregate"]):
+            np.testing.assert_allclose(a, b, rtol=0, atol=2e-6)
+
+    def test_aggregate_adagrad_converges(self):
+        """AdaGrad aggregate mode follows a different trajectory
+        (sync-aggregation); it must still fit the ratings."""
+        mesh = make_mesh(4, 2)
+        n_u, n_i = 96, 64
+        us, it, r = self._data(n_u, n_i, n=6000)
+        app = MatrixFactorization(n_u - 1, n_i - 1, rank=8, eta=0.1, l2=0.002,
+                                  reporter=quiet())
+        step = make_mf_spmd_train_step(
+            app.user_up, app.item_up, mesh, n_u, n_i, l2=0.002,
+            push_mode="aggregate",
+        )
+        user = shard_state(app.user_state, mesh)
+        item = shard_state(app.item_state, mesh)
+        builder = MFBatchBuilder(batch_size=380)
+        first = last = None
+        for epoch in range(12):
+            order = np.random.default_rng(epoch).permutation(6000)
+            for s in range(0, 6000, 1500):
+                sel = order[s : s + 1500]
+                bs = [builder.build(us[sel[i::4]], it[sel[i::4]], r[sel[i::4]])
+                      for i in range(4)]
+                user, item, loss = step(user, item, stack_mf_batches(bs, mesh))
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+        assert last < first * 0.3, (first, last)
+
+
+class TestWideDeepAggregatePush:
+    def test_learns_xor_aggregate(self):
+        mesh = make_mesh(2, 2)
+        app = WideDeep(num_keys=64, emb_dim=8, hidden=[16], mlp_lr=5e-3,
+                       reporter=quiet())
+        step = make_wd_spmd_train_step(
+            app.wide_up, app.emb_up, app.opt, mesh, app.num_keys,
+            push_mode="aggregate",
+        )
+        builder = BatchBuilder(num_keys=64, batch_size=256, key_mode="identity")
+        batches, _ = TestWideDeepSPMD()._xor_batches(builder)
+        wide = shard_state(app.wide_state, mesh)
+        emb = shard_state(app.emb_state, mesh)
+        mlp, opt_state = app.mlp_params, app.opt_state
+        losses = []
+        for epoch in range(40):
+            for s in range(0, len(batches) - 1, 2):
+                stacked = stack_batches(batches[s : s + 2], mesh)
+                wide, emb, mlp, opt_state, loss, _ = step(
+                    wide, emb, mlp, opt_state, stacked
+                )
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.3, losses[::8]
+
+
 class TestWord2VecSPMD:
-    def test_learns_structure_on_mesh(self):
+    @pytest.mark.parametrize("push_mode", ["per_worker", "aggregate"])
+    def test_learns_structure_on_mesh(self, push_mode):
         """BASELINE's word2vec config on the mesh: both embedding tables
         range-sharded over kv, pair batches over data, SSP-gated dispatch
-        (max_delay=1) with no per-batch device sync."""
+        (max_delay=1) with no per-batch device sync. Aggregate mode is the
+        AdaGrad sync-aggregation trajectory — quality must hold there too."""
         from parameter_server_tpu.models.word2vec import Word2Vec
 
         mesh = make_mesh(2, 4)
@@ -124,7 +228,8 @@ class TestWord2VecSPMD:
         # test converges with (smaller per-push batches decay Adagrad's
         # effective lr too fast on this tiny corpus)
         w2v = Word2Vec(vocab_size=16, dim=16, eta=0.5, num_negatives=4,
-                       window=2, reporter=quiet(), mesh=mesh, max_delay=1)
+                       window=2, reporter=quiet(), mesh=mesh, max_delay=1,
+                       push_mode=push_mode)
         losses = [
             w2v.train_epoch(corpus, batch_size=2048, seed=ep)
             for ep in range(8)
