@@ -1,22 +1,42 @@
-"""Benchmark: flagship sparse-LR FTRL training throughput.
+"""Benchmark suite: flagship sparse-LR FTRL throughput + sub-benches.
 
-Prints ONE JSON line:
+Prints ONE JSON line. Headline fields (driver contract):
   {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": R}
 
-value       — steady-state training examples/sec of the fused TPU step
-              (pull -> CSR grad -> FTRL push) on the available device.
+value       — steady-state training examples/sec of the fused device step
+              (pull -> CSR grad -> FTRL push), median of 3 timed passes.
 vs_baseline — speedup over a single-core numpy implementation of the exact
-              same algorithm (the reference's C++ server+worker collapse to
-              one host here; BASELINE.md records why the true reference
-              cannot be executed in this environment).
+              same algorithm (median of 3 passes over 8 batches; raw
+              numbers for both sides are in "raw" so the ratio's noise is
+              auditable). BASELINE.md records why the true reference
+              cannot be executed in this environment.
+
+Extra fields:
+  raw  — the individual timed passes behind the headline numbers.
+  sub  — sub-benches:
+    pallas_ftrl  — fused Pallas FTRL delta vs the jnp composite on the
+                   same rows (timed for real on TPU; correctness-checked
+                   in interpret mode on CPU where timing it is
+                   meaningless). If the kernel wins on TPU the headline
+                   step is re-run with use_pallas=True and the better
+                   number is reported (headline_use_pallas says which).
+    spmd_push    — per_worker vs aggregate push wall-clock on a
+                   (data=8, kv=1) mesh (8-device virtual CPU child
+                   process), substantiating the aggregate-mode claim
+                   with a measurement.
+    pipeline_e2e — end-to-end files -> trained AUC throughput through
+                   the parallel host input pipeline (parse + build +
+                   train), pipelined vs serial ingest.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -52,15 +72,17 @@ BATCH = 8192
 NNZ_PER = 32
 NUM_KEYS = 1 << 20
 N_BATCHES = 12
+BASELINE_BATCHES = 8
+REPEATS = 3
 ALPHA, BETA, L1, L2 = 0.1, 1.0, 1.0, 0.0
 
 
-def _make_batches():
+def _make_batches(n_batches: int = N_BATCHES):
     from parameter_server_tpu.data.batch import BatchBuilder
     from parameter_server_tpu.data.synthetic import make_sparse_logistic
 
     labels, keys, vals, _ = make_sparse_logistic(
-        BATCH * N_BATCHES, 1 << 18, nnz_per_example=NNZ_PER, noise=0.4, seed=7
+        BATCH * n_batches, 1 << 18, nnz_per_example=NNZ_PER, noise=0.4, seed=7
     )
     builder = BatchBuilder(
         num_keys=NUM_KEYS, batch_size=BATCH, max_nnz_per_example=4 * NNZ_PER
@@ -69,65 +91,259 @@ def _make_batches():
         builder.build(
             labels[i : i + BATCH], keys[i : i + BATCH], vals[i : i + BATCH]
         )
-        for i in range(0, BATCH * N_BATCHES, BATCH)
+        for i in range(0, BATCH * n_batches, BATCH)
     ]
 
 
-def bench_device(batches) -> float:
+def bench_device(batches, use_pallas: bool = False) -> tuple[float, list[float]]:
+    """Median-of-REPEATS steady-state device throughput (examples/sec)."""
     import jax
 
     from parameter_server_tpu.kv.updaters import Ftrl
     from parameter_server_tpu.models.linear import batch_to_device, train_step
 
-    up = Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2)
-    state = up.init(NUM_KEYS, 1)
+    up = Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2,
+              use_pallas=use_pallas)
     dev_batches = [batch_to_device(b) for b in batches]
-    # warmup/compile
-    state, out = train_step(up, state, dev_batches[0])
-    jax.block_until_ready(out["loss_sum"])
-    t0 = time.perf_counter()
-    for b in dev_batches[1:]:
-        state, out = train_step(up, state, b)
-    jax.block_until_ready(out["loss_sum"])
-    dt = time.perf_counter() - t0
-    return BATCH * (len(dev_batches) - 1) / dt
+    # enough steps that one timed run is O(100ms) even on fast chips —
+    # an 11-step run finishes in <1ms on TPU and times only noise
+    cycles = 5
+    runs = []
+    for _ in range(REPEATS):
+        state = up.init(NUM_KEYS, 1)
+        # warmup/compile
+        state, out = train_step(up, state, dev_batches[0])
+        jax.block_until_ready(out["loss_sum"])
+        t0 = time.perf_counter()
+        steps = 0
+        for _ in range(cycles):
+            for b in dev_batches[1:]:
+                state, out = train_step(up, state, b)
+                steps += 1
+        jax.block_until_ready(out["loss_sum"])
+        dt = time.perf_counter() - t0
+        runs.append(BATCH * steps / dt)
+    return statistics.median(runs), [round(r, 1) for r in runs]
 
 
-def bench_numpy_baseline(batches) -> float:
-    """Single-core numpy FTRL on identical batches (2 batches, extrapolated)."""
-    z = np.zeros(NUM_KEYS, dtype=np.float32)
-    n = np.zeros(NUM_KEYS, dtype=np.float32)
-    sub = batches[:2]
-    t0 = time.perf_counter()
-    for b in sub:
-        nnz, U = b.num_entries, len(b.unique_keys)
-        idx = b.unique_keys
-        # pull
-        shrunk = np.sign(z[idx]) * np.maximum(np.abs(z[idx]) - L1, 0.0)
-        w_u = -shrunk / ((BETA + np.sqrt(n[idx])) / ALPHA + L2)
-        # forward
-        contrib = b.values * w_u[b.local_ids]
-        logits = np.bincount(b.row_ids, weights=contrib, minlength=BATCH)
-        p = 1.0 / (1.0 + np.exp(-logits))
-        err = (p - b.labels) * b.example_mask
-        # grad per unique key
-        g = np.bincount(
-            b.local_ids, weights=b.values * err[b.row_ids], minlength=U
-        ).astype(np.float32)
-        # FTRL push
-        n_new = n[idx] + g * g
-        sigma = (np.sqrt(n_new) - np.sqrt(n[idx])) / ALPHA
-        z[idx] += g - sigma * w_u
-        n[idx] = n_new
-    dt = time.perf_counter() - t0
-    return BATCH * len(sub) / dt
+def bench_numpy_baseline(batches) -> tuple[float, list[float]]:
+    """Single-core numpy FTRL on identical batches, median of REPEATS
+    passes over BASELINE_BATCHES batches (state reset per pass)."""
+    runs = []
+    for _ in range(REPEATS):
+        z = np.zeros(NUM_KEYS, dtype=np.float32)
+        n = np.zeros(NUM_KEYS, dtype=np.float32)
+        sub = batches[:BASELINE_BATCHES]
+        t0 = time.perf_counter()
+        for b in sub:
+            U = len(b.unique_keys)
+            idx = b.unique_keys
+            # pull
+            shrunk = np.sign(z[idx]) * np.maximum(np.abs(z[idx]) - L1, 0.0)
+            w_u = -shrunk / ((BETA + np.sqrt(n[idx])) / ALPHA + L2)
+            # forward
+            contrib = b.values * w_u[b.local_ids]
+            logits = np.bincount(b.row_ids, weights=contrib, minlength=BATCH)
+            p = 1.0 / (1.0 + np.exp(-logits))
+            err = (p - b.labels) * b.example_mask
+            # grad per unique key
+            g = np.bincount(
+                b.local_ids, weights=b.values * err[b.row_ids], minlength=U
+            ).astype(np.float32)
+            # FTRL push
+            n_new = n[idx] + g * g
+            sigma = (np.sqrt(n_new) - np.sqrt(n[idx])) / ALPHA
+            z[idx] += g - sigma * w_u
+            n[idx] = n_new
+        dt = time.perf_counter() - t0
+        runs.append(BATCH * len(sub) / dt)
+    return statistics.median(runs), [round(r, 1) for r in runs]
+
+
+def bench_pallas_ftrl() -> dict:
+    """Fused Pallas FTRL delta vs the jnp composite over 2^20 rows."""
+    import jax.numpy as jnp
+
+    from parameter_server_tpu.kv.updaters import Ftrl
+    from parameter_server_tpu.ops.pallas_kernels import tpu_available
+
+    rows_n = 1 << 20
+    rng = np.random.default_rng(3)
+    rows = {
+        "z": jnp.asarray(rng.normal(size=(rows_n, 1)).astype(np.float32)),
+        "n": jnp.asarray(np.abs(rng.normal(size=(rows_n, 1))).astype(np.float32)),
+    }
+    g = jnp.asarray(rng.normal(size=(rows_n, 1)).astype(np.float32))
+    kw = dict(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2)
+
+    def _time(up) -> float:
+        import jax
+
+        f = jax.jit(lambda r, gg: up.delta(r, gg))
+        jax.block_until_ready(f(rows, g))  # compile
+        iters = 30
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(rows, g)
+        jax.block_until_ready(out)
+        return rows_n * iters / (time.perf_counter() - t0)
+
+    jnp_rows = _time(Ftrl(**kw))
+    if not tpu_available():
+        # timing interpret mode is meaningless; check numerics instead
+        from jax.experimental.pallas import tpu as pltpu
+
+        from parameter_server_tpu.ops.pallas_kernels import ftrl_delta_pallas
+
+        small = {k: v[:4096] for k, v in rows.items()}
+        ref = Ftrl(**kw).delta(small, g[:4096])
+        with pltpu.force_tpu_interpret_mode():
+            dz, dn = ftrl_delta_pallas(
+                small["z"], small["n"], g[:4096],
+                alpha=ALPHA, beta=BETA, l1=L1, l2=L2,
+            )
+        ok = bool(
+            np.allclose(np.asarray(dz), np.asarray(ref["z"]), atol=1e-6)
+            and np.allclose(np.asarray(dn), np.asarray(ref["n"]), atol=1e-6)
+        )
+        return {
+            "mode": "interpret (no TPU: numerics checked, not timed)",
+            "jnp_rows_per_sec": round(jnp_rows, 1),
+            "interpret_matches_jnp": ok,
+        }
+    pallas_rows = _time(Ftrl(**kw, use_pallas=True))
+    return {
+        "mode": "real",
+        "jnp_rows_per_sec": round(jnp_rows, 1),
+        "pallas_rows_per_sec": round(pallas_rows, 1),
+        "pallas_speedup": round(pallas_rows / jnp_rows, 3),
+    }
+
+
+def bench_spmd_push_child() -> None:
+    """Child entry (8-device virtual CPU mesh): per_worker vs aggregate
+    push wall-clock on a (data=8, kv=1) mesh."""
+    import jax
+
+    from parameter_server_tpu.data.batch import BatchBuilder
+    from parameter_server_tpu.data.synthetic import make_sparse_logistic
+    from parameter_server_tpu.kv.updaters import Ftrl
+    from parameter_server_tpu.parallel.mesh import make_mesh
+    from parameter_server_tpu.parallel.spmd import (
+        make_spmd_train_step,
+        shard_state,
+        stack_batches,
+    )
+
+    D, num_keys, bs, nnz = 8, 1 << 18, 2048, 32
+    labels, keys, vals, _ = make_sparse_logistic(
+        bs * D * 4, 1 << 16, nnz_per_example=nnz, noise=0.4, seed=11
+    )
+    builder = BatchBuilder(
+        num_keys=num_keys, batch_size=bs, max_nnz_per_example=4 * nnz
+    )
+    batches = [
+        builder.build(labels[i : i + bs], keys[i : i + bs], vals[i : i + bs])
+        for i in range(0, bs * D * 4, bs)
+    ]
+    mesh = make_mesh(D, 1)
+    up = Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2)
+    out: dict = {"data_shards": D, "platform": "cpu-sim"}
+    for mode in ("per_worker", "aggregate"):
+        step = make_spmd_train_step(up, mesh, num_keys, push_mode=mode)
+        state = shard_state(up.init(num_keys, 1), mesh)
+        stacked = [
+            stack_batches(batches[i : i + D], mesh)
+            for i in range(0, len(batches), D)
+        ]
+        state, o = step(state, stacked[0])  # compile
+        jax.block_until_ready(o["loss_sum"])
+        t0 = time.perf_counter()
+        for s in stacked[1:]:
+            state, o = step(state, s)
+        jax.block_until_ready(o["loss_sum"])
+        dt = time.perf_counter() - t0
+        out[f"{mode}_ex_per_sec"] = round(bs * D * (len(stacked) - 1) / dt, 1)
+    out["aggregate_speedup"] = round(
+        out["aggregate_ex_per_sec"] / out["per_worker_ex_per_sec"], 3
+    )
+    print(json.dumps(out))
+
+
+def bench_spmd_push() -> dict:
+    """Run the (data=8) push-mode comparison in an 8-device CPU child."""
+    from parameter_server_tpu.utils.hostenv import force_cpu
+
+    env = dict(os.environ)
+    force_cpu(env)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--spmd-push-child"],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            return json.loads(r.stdout.strip().splitlines()[-1])
+        return {"error": (r.stderr or "no output").strip()[-500:]}
+    except subprocess.TimeoutExpired:
+        return {"error": "spmd push child timed out"}
+
+
+def bench_pipeline_e2e() -> dict:
+    """End-to-end files -> trained AUC throughput (parse + batch build +
+    train) through the parallel host pipeline, vs serial inline ingest."""
+    from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
+    from parameter_server_tpu.parallel.trainer import PodTrainer
+    from parameter_server_tpu.utils.config import PSConfig
+    from parameter_server_tpu.utils.metrics import ProgressReporter
+
+    n, files = 1 << 16, 4
+    labels, keys, vals, _ = make_sparse_logistic(
+        n, 1 << 16, nnz_per_example=NNZ_PER, noise=0.4, seed=23
+    )
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as d:
+        paths = []
+        per = n // files
+        for i in range(files):
+            p = os.path.join(d, f"part-{i}.svm")
+            s = slice(i * per, (i + 1) * per)
+            write_libsvm(p, labels[s], keys[s], vals[s])
+            paths.append(p)
+        for depth, label in ((2, "pipelined"), (0, "serial")):
+            cfg = PSConfig()
+            cfg.data.num_keys = NUM_KEYS
+            cfg.data.pipeline_depth = depth
+            cfg.solver.minibatch = 4096
+            cfg.penalty.lambda_l1 = L1
+            t = PodTrainer(cfg, reporter=ProgressReporter(print_fn=lambda *_: None))
+            t.train_files(paths[:1], report_every=1000)  # compile warmup
+            t0 = time.perf_counter()
+            last = t.train_files(paths, report_every=1000)
+            dt = time.perf_counter() - t0
+            out[f"{label}_ex_per_sec"] = round(n / dt, 1)
+            if depth == 2:
+                out["auc"] = round(last.get("auc", float("nan")), 4)
+    return out
 
 
 def main() -> None:
     platform = _ensure_reachable_backend()
     batches = _make_batches()
-    baseline = bench_numpy_baseline(batches)
-    value = bench_device(batches)
+    baseline, baseline_runs = bench_numpy_baseline(batches)
+    value, device_runs = bench_device(batches)
+    headline_use_pallas = False
+    pallas = bench_pallas_ftrl()
+    if pallas.get("mode") == "real" and pallas.get("pallas_speedup", 0) > 1.0:
+        v2, runs2 = bench_device(batches, use_pallas=True)
+        pallas["headline_step_ex_per_sec_pallas"] = round(v2, 1)
+        if v2 > value:
+            value, device_runs = v2, runs2
+            headline_use_pallas = True
     print(
         json.dumps(
             {
@@ -136,10 +352,31 @@ def main() -> None:
                 "unit": "examples/sec",
                 "vs_baseline": round(value / baseline, 2),
                 "platform": platform,
+                "raw": {
+                    "device_ex_per_sec_runs": device_runs,
+                    "baseline_ex_per_sec": round(baseline, 1),
+                    "baseline_ex_per_sec_runs": baseline_runs,
+                    "baseline_batches": BASELINE_BATCHES,
+                    "headline_use_pallas": headline_use_pallas,
+                },
+                "sub": {
+                    "pallas_ftrl": pallas,
+                    "spmd_push": bench_spmd_push(),
+                    "pipeline_e2e": bench_pipeline_e2e(),
+                },
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--spmd-push-child" in sys.argv:
+        from parameter_server_tpu.utils.hostenv import force_cpu
+
+        force_cpu(os.environ)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        bench_spmd_push_child()
+    else:
+        main()

@@ -27,17 +27,32 @@ import jax.numpy as jnp
 
 _LANES = 128
 _SUBLANES = 8
+# rows per grid step: VMEM is ~16 MiB scoped; 5 live (TILE_M, 128) f32
+# refs at 1024 rows = 2.5 MiB, leaving room for double-buffered pipelining
+_TILE_M = 1024
 
 
-def _pad_to_tiles(x: jax.Array) -> tuple[jax.Array, int]:
-    """Flatten to 1-D and pad so it reshapes to (M, 128) with M % 8 == 0."""
+def _pad_to_tiles(x: jax.Array, row_multiple: int = _SUBLANES) -> tuple[jax.Array, int]:
+    """Flatten to 1-D and pad so it reshapes to (M, 128) with
+    M % row_multiple == 0 (grids tile rows in row_multiple chunks)."""
     flat = x.reshape(-1)
     n = flat.shape[0]
-    tile = _LANES * _SUBLANES
+    tile = _LANES * row_multiple
     padded = (n + tile - 1) // tile * tile
     if padded != n:
         flat = jnp.pad(flat, (0, padded - n))
     return flat.reshape(-1, _LANES), n
+
+
+def _tiled(x: jax.Array) -> tuple[jax.Array, int, int, int]:
+    """Pad + reshape to (M, 128) and pick a row tiling: small arrays run
+    as one block; large ones pad M to a _TILE_M multiple and grid over
+    row tiles (an ungridded call would stage the WHOLE array into VMEM
+    and OOM its ~16 MiB scoped limit on real hardware)."""
+    row_mult = _TILE_M if x.size > _TILE_M * _LANES else _SUBLANES
+    mat, count = _pad_to_tiles(x, row_mult)
+    tile_m = min(_TILE_M, mat.shape[0])
+    return mat, count, tile_m, mat.shape[0] // tile_m
 
 
 def _unpad(mat: jax.Array, n: int, shape) -> jax.Array:
@@ -73,35 +88,36 @@ def ftrl_delta_pallas(
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    zm, count = _pad_to_tiles(z)
-    nm, _ = _pad_to_tiles(n)
-    gm, _ = _pad_to_tiles(g)
+    zm, count, tile_m, grid = _tiled(z)
+    nm, _, _, _ = _tiled(n)
+    gm, _, _, _ = _tiled(g)
     kernel = functools.partial(
         _ftrl_delta_kernel, alpha=alpha, beta=beta, l1=l1, l2=l2
     )
+    row_block = pl.BlockSpec(
+        (tile_m, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
     dz, dn = pl.pallas_call(
         kernel,
+        grid=(grid,),
         out_shape=(
             jax.ShapeDtypeStruct(zm.shape, zm.dtype),
             jax.ShapeDtypeStruct(nm.shape, nm.dtype),
         ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ),
+        in_specs=[row_block, row_block, row_block],
+        out_specs=(row_block, row_block),
     )(zm, nm, gm)
     return _unpad(dz, count, z.shape), _unpad(dn, count, n.shape)
 
 
 def _quantize_kernel(seed_ref, params_ref, x_ref, q_ref, *, levels):
+    from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    pltpu.prng_seed(seed_ref[0])
+    # per-grid-step seed, decorrelated across calls: seed+1 must not
+    # reproduce this call's tile streams shifted by one (callers pass
+    # consecutive per-step seeds)
+    pltpu.prng_seed(seed_ref[0] * pl.num_programs(0) + pl.program_id(0))
     lo = params_ref[0]
     scale = params_ref[1]
     t = (x_ref[:] - lo) / scale  # in [0, levels]
@@ -132,17 +148,21 @@ def quantize_stochastic_pallas(
     lo = jnp.min(x).astype(jnp.float32)
     hi = jnp.max(x).astype(jnp.float32)
     scale = jnp.maximum(hi - lo, 1e-30) / levels
-    xm, count = _pad_to_tiles(x)
+    xm, count, tile_m, grid = _tiled(x)
     kernel = functools.partial(_quantize_kernel, levels=levels)
     q = pl.pallas_call(
         kernel,
+        grid=(grid,),
         out_shape=jax.ShapeDtypeStruct(xm.shape, dtype),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((2,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile_m, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec(
+            (tile_m, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
     )(
         jnp.asarray([seed], dtype=jnp.int32),
         jnp.stack([lo, scale]),

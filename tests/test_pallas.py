@@ -1,5 +1,9 @@
-"""Pallas kernel numerics tests (interpret mode on the CPU mesh; the real
-kernels run on TPU via bench.py and the use_pallas updater flag)."""
+"""Pallas kernel numerics tests (interpret mode on the CPU mesh).
+
+The real (non-interpret) kernels only execute on TPU hardware:
+bench.py's pallas_ftrl sub-bench times the fused FTRL delta against the
+jnp composite there and flips the headline step to use_pallas=True when
+the kernel wins; nothing in this CPU test tree runs them for real."""
 
 import jax
 import jax.numpy as jnp
