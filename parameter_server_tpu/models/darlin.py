@@ -220,51 +220,108 @@ def _objective(
 # ---------------------------------------------------------------------------
 
 
-def shard_blocks_for_mesh(cb: ColumnBlocks, data_shards: int) -> dict:
-    """Host-side prep: partition every block's entries by example shard.
+def shard_examples_for_mesh(cb: ColumnBlocks, data_shards: int) -> dict:
+    """(labels, mask) reshaped to (D, per) — examples padded to D * per."""
+    D = data_shards
+    N = cb.num_examples
+    per = -(-N // D)
+    labels = np.zeros(D * per, dtype=np.float32)
+    mask = np.zeros(D * per, dtype=np.float32)
+    labels[:N] = np.asarray(cb.labels, dtype=np.float32)
+    mask[:N] = 1.0
+    return {
+        "labels": labels.reshape(D, per),
+        "mask": mask.reshape(D, per),
+        "per_shard_examples": per,
+    }
 
-    Returns numpy arrays ready for ``stack → device_put``:
-      feat_local/rows/values: (n_blocks, D, E) with rows LOCAL to the shard
-      labels/mask: (D, per) — examples padded up to a multiple of D
+
+def shard_blocks_for_mesh(
+    cb: ColumnBlocks,
+    data_shards: int,
+    blocks: np.ndarray | None = None,
+    pad_pow2: bool = False,
+) -> dict:
+    """Host-side prep: partition block entries by example shard — fully
+    vectorized (one argsort over the selected entries; no per-block Python
+    loops).
+
+    blocks: optional subset/order of block indices to pack. The streaming
+      solver packs one chunk at a time straight from the (possibly mmap'd)
+      block cache, so only the chunk's rows are ever read into RAM.
+    pad_pow2: round the entry width E up to a power of two, bounding jit
+      recompilation across streamed chunks to O(log E) distinct shapes.
+
+    Returns numpy arrays ready for device_put:
+      feat_local/rows/values: (B, D, E) with rows LOCAL to the shard and
+        E = the max per-(block, shard) entry count of THIS selection (not
+        a global max — padding stays bounded by the selection's own skew)
+      block_idx: (B,) absolute block ids; counts: (B, D) real entry counts
+    (labels/mask come from ``shard_examples_for_mesh`` — computed once per
+    solve, not per packed chunk).
     """
     D = data_shards
     N = cb.num_examples
     per = -(-N // D)  # ceil: examples padded to D * per
-    shard_of_row = lambda r: r // per  # contiguous example ranges
-
-    counts = np.zeros((cb.n_blocks, D), dtype=np.int64)
-    shard_ids = []
-    for i in range(cb.n_blocks):
-        s = shard_of_row(cb.rows[i])
-        # pad entries (values == 0) all land in shard 0 — harmless, they
-        # contribute nothing to any segment sum
-        shard_ids.append(s)
-        counts[i] = np.bincount(s, minlength=D)
+    sel = (
+        np.arange(cb.n_blocks, dtype=np.int64)
+        if blocks is None
+        else np.asarray(blocks, dtype=np.int64)
+    )
+    B = len(sel)
+    # fancy-index (mmap-friendly: reads only the selected blocks' rows)
+    feat_src = np.asarray(cb.feat_local[sel])
+    rows_src = np.asarray(cb.rows[sel])
+    vals_src = np.asarray(cb.values[sel])
+    E_src = feat_src.shape[1]
+    s = rows_src // per  # (B, E_src) example shard per entry (contiguous
+    # ranges); cb pad entries (value == 0) sit at row 0 => shard 0, inert
+    key = (
+        np.arange(B, dtype=np.int64)[:, None] * D + s
+    ).ravel()  # group = (block, shard)
+    order = np.argsort(key, kind="stable")
+    k_sorted = key[order]
+    counts = np.bincount(key, minlength=B * D)
+    starts = np.zeros(B * D + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    pos = np.arange(B * E_src, dtype=np.int64) - starts[k_sorted]
     E = max(1, int(counts.max()))
-    feat = np.zeros((cb.n_blocks, D, E), dtype=cb.feat_local.dtype)
-    rows = np.zeros((cb.n_blocks, D, E), dtype=cb.rows.dtype)
-    vals = np.zeros((cb.n_blocks, D, E), dtype=cb.values.dtype)
-    for i in range(cb.n_blocks):
-        s = shard_ids[i]
-        for d in range(D):
-            m = s == d
-            k = int(m.sum())
-            feat[i, d, :k] = cb.feat_local[i][m]
-            rows[i, d, :k] = cb.rows[i][m] - d * per
-            vals[i, d, :k] = cb.values[i][m]
-    labels = np.zeros((D, per), dtype=np.float32)
-    mask = np.zeros((D, per), dtype=np.float32)
-    flat = np.asarray(cb.labels, dtype=np.float32)
-    for d in range(D):
-        lo = d * per
-        hi = min(lo + per, N)
-        if hi > lo:
-            labels[d, : hi - lo] = flat[lo:hi]
-            mask[d, : hi - lo] = 1.0
+    if pad_pow2:
+        E = 1 << (E - 1).bit_length()
+    feat = np.zeros((B * D, E), dtype=feat_src.dtype)
+    rows = np.zeros((B * D, E), dtype=rows_src.dtype)
+    vals = np.zeros((B * D, E), dtype=vals_src.dtype)
+    local_rows = rows_src - s * per  # localize BEFORE packing: packed
+    # padding slots stay 0 (a valid inert local row), never negative
+    feat[k_sorted, pos] = feat_src.ravel()[order]
+    rows[k_sorted, pos] = local_rows.ravel()[order]
+    vals[k_sorted, pos] = vals_src.ravel()[order]
     return {
-        "feat_local": feat, "rows": rows, "values": vals,
-        "labels": labels, "mask": mask, "per_shard_examples": per,
+        "feat_local": feat.reshape(B, D, E),
+        "rows": rows.reshape(B, D, E),
+        "values": vals.reshape(B, D, E),
+        "block_idx": sel.astype(np.int32),
+        "counts": counts.reshape(B, D),
+        "per_shard_examples": per,
     }
+
+
+class DarlinSpmdFns:
+    """The jitted mesh programs of the distributed solver.
+
+    pass_resident / kkt_resident — scan over a permutation array, gathering
+      each block's entries from DEVICE-RESIDENT stacked arrays (device_put
+      once per solve; the per-iteration block shuffle never re-uploads or
+      re-materializes the data).
+    pass_chunk / kkt_chunk — scan over a streamed chunk of blocks handed in
+      as its own (C, D, E) arrays (the bounded-memory path; each distinct
+      (C, E) pair compiles once — the streaming driver pads E to powers of
+      two to bound that).
+    obj — pod-wide objective; place — put host arrays with solver sharding.
+    """
+
+    def __init__(self, **fns):
+        self.__dict__.update(fns)
 
 
 def make_darlin_spmd_fns(
@@ -277,8 +334,8 @@ def make_darlin_spmd_fns(
     lambda_l2: float,
     learning_rate: float,
     delay: int,
-):
-    """Build (pass_fn, kkt_fn, objective_fn) jitted over the mesh.
+) -> DarlinSpmdFns:
+    """Build the solver's jitted mesh programs (see DarlinSpmdFns).
 
     Layout: w/active P("kv"); pred/labels/mask P("data", None); block entry
     arrays P(None, "data", None). Requires num_keys divisible by kv and
@@ -317,91 +374,145 @@ def make_darlin_spmd_fns(
         )
         return lax.psum(g, "data"), lax.psum(h, "data")  # push
 
-    def local_pass(w_l, pred_l, active_l, blocks_l, y_l, mask_l):
+    def _block_body(carry, fl, rows, vals, b_idx, y_l, mask_l):
+        """One block's proximal step — shared by both pass variants so the
+        trajectory-parity contract with the single-device solver lives in
+        exactly one place."""
+        w_l, pred_l, stale_pred, active_l, viol_max, i = carry
+        refresh = (i % (delay + 1)) == 0
+        stale_pred = jnp.where(refresh, pred_l, stale_pred)
+        my_k = lax.axis_index("kv")
+        begin = b_idx * block_size
+        owner = begin // shard_size
+        is_owner = owner == my_k
+        safe_begin = jnp.where(is_owner, begin - owner * shard_size, 0)
+
+        g, h = _block_grad(stale_pred, y_l, mask_l, fl, rows, vals)
+        w_b = _bcast_from_owner(
+            lax.dynamic_slice(w_l, (safe_begin,), (block_size,)), is_owner
+        )
+        act_b = (
+            _bcast_from_owner(
+                lax.dynamic_slice(
+                    active_l.astype(jnp.float32), (safe_begin,), (block_size,)
+                ),
+                is_owner,
+            )
+            > 0
+        )
+
+        viol = _kkt_viol(w_b, g, lambda_l1)
+        viol_max = jnp.maximum(viol_max, viol.max())
+        skip = (~act_b) & (w_b == 0.0)
+        d = _prox_newton_direction(
+            w_b, g, h, skip, lambda_l1, lambda_l2, learning_rate
+        )
+        # my example shard's X_b @ d; the line-search objective is the
+        # TRUE pod-wide objective (masked nll psum'd over "data")
+        Xd_l = jax.ops.segment_sum(
+            vals * jnp.take(d, fl), rows, num_segments=per
+        )
+        alpha = _line_search_alpha(
+            pred_l, Xd_l, y_l, w_b, d, lambda_l1, lambda_l2,
+            mask=mask_l, reduce=lambda x: lax.psum(x, "data"),
+        )
+
+        new_w_b = w_b + alpha * d
+        w_l = jnp.where(
+            is_owner,
+            lax.dynamic_update_slice(w_l, new_w_b, (safe_begin,)),
+            w_l,
+        )
+        pred_l = pred_l + alpha * Xd_l
+        return (w_l, pred_l, stale_pred, active_l, viol_max, i + 1)
+
+    def _kkt_body(active_l, w_l, pred_l, y_l, mask_l, thr, fl, rows, vals, b_idx):
+        my_k = lax.axis_index("kv")
+        begin = b_idx * block_size
+        owner = begin // shard_size
+        is_owner = owner == my_k
+        safe_begin = jnp.where(is_owner, begin - owner * shard_size, 0)
+        g, _ = _block_grad(pred_l, y_l, mask_l, fl, rows, vals)
+        w_b = _bcast_from_owner(
+            lax.dynamic_slice(w_l, (safe_begin,), (block_size,)), is_owner
+        )
+        new_act = (w_b != 0.0) | (_kkt_viol(w_b, g, lambda_l1) > thr)
+        return jnp.where(
+            is_owner,
+            lax.dynamic_update_slice(active_l, new_act, (safe_begin,)),
+            active_l,
+        )
+
+    def _take_block(blocks_l, idx):
+        """Gather block ``idx``'s local entries from the device-resident
+        stacks (each a local (n_blocks, 1, E) slice under shard_map)."""
+        return tuple(
+            lax.dynamic_index_in_dim(blocks_l[k], idx, 0, keepdims=False)[0]
+            for k in ("feat_local", "rows", "values")
+        )
+
+    def local_pass_resident(w_l, pred_l, active_l, blocks_l, order, y_l, mask_l):
         # squeeze this device's singleton data-axis slice
         pred_l, y_l, mask_l = pred_l[0], y_l[0], mask_l[0]
-        my_k = lax.axis_index("kv")
 
-        def block_step(carry, blk):
-            w_l, pred_l, stale_pred, active_l, viol_max, i = carry
-            refresh = (i % (delay + 1)) == 0
-            stale_pred = jnp.where(refresh, pred_l, stale_pred)
-            fl, rows, vals = blk["feat_local"][0], blk["rows"][0], blk["values"][0]
-            b_idx = blk["block_idx"]
-            begin = b_idx * block_size
-            owner = begin // shard_size
-            is_owner = owner == my_k
-            safe_begin = jnp.where(is_owner, begin - owner * shard_size, 0)
-
-            g, h = _block_grad(stale_pred, y_l, mask_l, fl, rows, vals)
-            w_b = _bcast_from_owner(
-                lax.dynamic_slice(w_l, (safe_begin,), (block_size,)), is_owner
-            )
-            act_b = (
-                _bcast_from_owner(
-                    lax.dynamic_slice(
-                        active_l.astype(jnp.float32), (safe_begin,), (block_size,)
-                    ),
-                    is_owner,
-                )
-                > 0
-            )
-
-            viol = _kkt_viol(w_b, g, lambda_l1)
-            viol_max = jnp.maximum(viol_max, viol.max())
-            skip = (~act_b) & (w_b == 0.0)
-            d = _prox_newton_direction(
-                w_b, g, h, skip, lambda_l1, lambda_l2, learning_rate
-            )
-            # my example shard's X_b @ d; the line-search objective is the
-            # TRUE pod-wide objective (masked nll psum'd over "data")
-            Xd_l = jax.ops.segment_sum(
-                vals * jnp.take(d, fl), rows, num_segments=per
-            )
-            alpha = _line_search_alpha(
-                pred_l, Xd_l, y_l, w_b, d, lambda_l1, lambda_l2,
-                mask=mask_l, reduce=lambda x: lax.psum(x, "data"),
-            )
-
-            new_w_b = w_b + alpha * d
-            w_l = jnp.where(
-                is_owner,
-                lax.dynamic_update_slice(w_l, new_w_b, (safe_begin,)),
-                w_l,
-            )
-            pred_l = pred_l + alpha * Xd_l
-            return (w_l, pred_l, stale_pred, active_l, viol_max, i + 1), None
+        def block_step(carry, idx):
+            fl, rows, vals = _take_block(blocks_l, idx)
+            return _block_body(carry, fl, rows, vals, idx, y_l, mask_l), None
 
         init = (w_l, pred_l, pred_l, active_l, jnp.float32(0.0), jnp.int32(0))
         (w_l, pred_l, _, active_l, viol_max, _), _ = lax.scan(
-            block_step, init, blocks_l
+            block_step, init, order
         )
         return w_l, pred_l[None, :], viol_max
 
-    def local_kkt(w_l, pred_l, active_l, blocks_l, y_l, mask_l, thr):
-        """On-device KKT active-set refresh (one more gradient pass)."""
+    def local_pass_chunk(w_l, pred_l, active_l, chunk_l, y_l, mask_l):
         pred_l, y_l, mask_l = pred_l[0], y_l[0], mask_l[0]
-        my_k = lax.axis_index("kv")
+
+        def block_step(carry, blk):
+            return (
+                _block_body(
+                    carry,
+                    blk["feat_local"][0], blk["rows"][0], blk["values"][0],
+                    blk["block_idx"], y_l, mask_l,
+                ),
+                None,
+            )
+
+        init = (w_l, pred_l, pred_l, active_l, jnp.float32(0.0), jnp.int32(0))
+        (w_l, pred_l, _, active_l, viol_max, _), _ = lax.scan(
+            block_step, init, chunk_l
+        )
+        return w_l, pred_l[None, :], viol_max
+
+    def local_kkt_resident(w_l, pred_l, active_l, blocks_l, order, y_l, mask_l, thr):
+        pred_l, y_l, mask_l = pred_l[0], y_l[0], mask_l[0]
+
+        def block_step(active_l, idx):
+            fl, rows, vals = _take_block(blocks_l, idx)
+            return (
+                _kkt_body(
+                    active_l, w_l, pred_l, y_l, mask_l, thr, fl, rows, vals, idx
+                ),
+                None,
+            )
+
+        active_l, _ = lax.scan(block_step, active_l, order)
+        return active_l
+
+    def local_kkt_chunk(w_l, pred_l, active_l, chunk_l, y_l, mask_l, thr):
+        pred_l, y_l, mask_l = pred_l[0], y_l[0], mask_l[0]
 
         def block_step(active_l, blk):
-            fl, rows, vals = blk["feat_local"][0], blk["rows"][0], blk["values"][0]
-            begin = blk["block_idx"] * block_size
-            owner = begin // shard_size
-            is_owner = owner == my_k
-            safe_begin = jnp.where(is_owner, begin - owner * shard_size, 0)
-            g, _ = _block_grad(pred_l, y_l, mask_l, fl, rows, vals)
-            w_b = _bcast_from_owner(
-                lax.dynamic_slice(w_l, (safe_begin,), (block_size,)), is_owner
+            return (
+                _kkt_body(
+                    active_l, w_l, pred_l, y_l, mask_l, thr,
+                    blk["feat_local"][0], blk["rows"][0], blk["values"][0],
+                    blk["block_idx"],
+                ),
+                None,
             )
-            new_act = (w_b != 0.0) | (_kkt_viol(w_b, g, lambda_l1) > thr)
-            active_l = jnp.where(
-                is_owner,
-                lax.dynamic_update_slice(active_l, new_act, (safe_begin,)),
-                active_l,
-            )
-            return active_l, None
 
-        active_l, _ = lax.scan(block_step, active_l, blocks_l)
+        active_l, _ = lax.scan(block_step, active_l, chunk_l)
         return active_l
 
     def local_obj(w_l, pred_l, y_l, mask_l):
@@ -416,22 +527,38 @@ def make_darlin_spmd_fns(
         return nll + reg
 
     kv_s, dat, blk_s = P("kv"), P("data", None), P(None, "data", None)
-    blocks_spec = {
-        "feat_local": blk_s, "rows": blk_s, "values": blk_s, "block_idx": P(None),
-    }
-    pass_fn = jax.jit(
+    resident_spec = {"feat_local": blk_s, "rows": blk_s, "values": blk_s}
+    chunk_spec = {**resident_spec, "block_idx": P(None)}
+    pass_resident = jax.jit(
         shard_map(
-            local_pass, mesh=mesh,
-            in_specs=(kv_s, dat, kv_s, blocks_spec, dat, dat),
+            local_pass_resident, mesh=mesh,
+            in_specs=(kv_s, dat, kv_s, resident_spec, P(None), dat, dat),
             out_specs=(kv_s, dat, P()),
             check_vma=False,
         ),
         donate_argnums=(0, 1),
     )
-    kkt_fn = jax.jit(
+    pass_chunk = jax.jit(
         shard_map(
-            local_kkt, mesh=mesh,
-            in_specs=(kv_s, dat, kv_s, blocks_spec, dat, dat, P()),
+            local_pass_chunk, mesh=mesh,
+            in_specs=(kv_s, dat, kv_s, chunk_spec, dat, dat),
+            out_specs=(kv_s, dat, P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1),
+    )
+    kkt_resident = jax.jit(
+        shard_map(
+            local_kkt_resident, mesh=mesh,
+            in_specs=(kv_s, dat, kv_s, resident_spec, P(None), dat, dat, P()),
+            out_specs=kv_s,
+            check_vma=False,
+        )
+    )
+    kkt_chunk = jax.jit(
+        shard_map(
+            local_kkt_chunk, mesh=mesh,
+            in_specs=(kv_s, dat, kv_s, chunk_spec, dat, dat, P()),
             out_specs=kv_s,
             check_vma=False,
         )
@@ -449,7 +576,27 @@ def make_darlin_spmd_fns(
         spec = {"w": kv_s, "active": kv_s, "pred": dat, "labels": dat, "mask": dat}[name]
         return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
 
-    return pass_fn, kkt_fn, obj_fn, place
+    def place_blocks(sharded: dict, with_idx: bool):
+        sh = NamedSharding(mesh, blk_s)
+        out = {
+            k: jax.device_put(jnp.asarray(sharded[k]), sh)
+            for k in ("feat_local", "rows", "values")
+        }
+        if with_idx:
+            out["block_idx"] = jax.device_put(
+                jnp.asarray(sharded["block_idx"]), NamedSharding(mesh, P(None))
+            )
+        return out
+
+    return DarlinSpmdFns(
+        pass_resident=pass_resident,
+        pass_chunk=pass_chunk,
+        kkt_resident=kkt_resident,
+        kkt_chunk=kkt_chunk,
+        obj=obj_fn,
+        place=place,
+        place_blocks=place_blocks,
+    )
 
 
 class Darlin:
@@ -485,15 +632,27 @@ class Darlin:
         return self._fit_blocks_single(cb, shuffle_blocks=shuffle_blocks)
 
     def _fit_blocks_spmd(self, cb: ColumnBlocks, shuffle_blocks: bool = True) -> dict:
-        """Distributed solve over the mesh (see module section above)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        """Distributed solve over the mesh (see module section above).
 
+        Two data-residency modes (cfg.solver.block_chunk):
+          0 (default) — resident: the packed (n_blocks, D, E) entry arrays
+            are device_put ONCE; the per-iteration block shuffle is just a
+            permutation array the on-device scan gathers through.
+          C > 0 — streaming: each pass packs+uploads C blocks at a time
+            straight from the (possibly mmap'd) block cache, so device and
+            host memory hold one chunk, not the dataset (ref: SlotReader's
+            stream-per-block design, SURVEY §3.3). Chunk widths pad to
+            powers of two to bound recompilation. With delay > 0 the stale
+            snapshot refreshes at chunk boundaries (a conservative
+            deviation: pick C a multiple of delay+1 to keep parity).
+        """
         cfg = self.cfg
         mesh = self.mesh
         D = mesh.shape["data"]
-        sharded = shard_blocks_for_mesh(cb, D)
-        per = sharded["per_shard_examples"]
-        pass_fn, kkt_fn, obj_fn, place = make_darlin_spmd_fns(
+        chunk = cfg.solver.block_chunk
+        ex = shard_examples_for_mesh(cb, D)
+        per = ex["per_shard_examples"]
+        fns = make_darlin_spmd_fns(
             mesh,
             num_keys=cb.num_keys,
             block_size=cb.block_size,
@@ -503,16 +662,29 @@ class Darlin:
             learning_rate=cfg.lr.eta,
             delay=cfg.solver.max_delay if cfg.solver.max_delay > 0 else 0,
         )
-        w = place("w", np.zeros(cb.num_keys, np.float32))
-        active = place("active", np.ones(cb.num_keys, bool))
-        pred = place("pred", np.zeros((D, per), np.float32))
-        labels = place("labels", sharded["labels"])
-        mask = place("mask", sharded["mask"])
-        blk_sh = NamedSharding(mesh, P(None, "data", None))
-        idx_sh = NamedSharding(mesh, P(None))
+        w = fns.place("w", np.zeros(cb.num_keys, np.float32))
+        active = fns.place("active", np.ones(cb.num_keys, bool))
+        pred = fns.place("pred", np.zeros((D, per), np.float32))
+        labels = fns.place("labels", ex["labels"])
+        mask = fns.place("mask", ex["mask"])
         rng = np.random.default_rng(cfg.seed)
 
-        prev_obj = float(obj_fn(w, pred, labels, mask))
+        resident_blocks = None
+        if chunk <= 0:
+            resident_blocks = fns.place_blocks(
+                shard_blocks_for_mesh(cb, D), with_idx=False
+            )
+
+        def _chunks(order):
+            for lo in range(0, len(order), chunk):
+                yield fns.place_blocks(
+                    shard_blocks_for_mesh(
+                        cb, D, blocks=order[lo : lo + chunk], pad_pow2=True
+                    ),
+                    with_idx=True,
+                )
+
+        prev_obj = float(fns.obj(w, pred, labels, mask))
         history = []
         for it in range(cfg.solver.block_iters):
             order = (
@@ -520,19 +692,31 @@ class Darlin:
                 if shuffle_blocks
                 else np.arange(cb.n_blocks)
             )
-            blocks = {
-                "feat_local": jax.device_put(sharded["feat_local"][order], blk_sh),
-                "rows": jax.device_put(sharded["rows"][order], blk_sh),
-                "values": jax.device_put(sharded["values"][order], blk_sh),
-                "block_idx": jax.device_put(order.astype(np.int32), idx_sh),
-            }
-            w, pred, viol = pass_fn(w, pred, active, blocks, labels, mask)
+            if resident_blocks is not None:
+                w, pred, viol = fns.pass_resident(
+                    w, pred, active, resident_blocks,
+                    order.astype(np.int32), labels, mask,
+                )
+            else:
+                viol = jnp.float32(0.0)
+                for blk in _chunks(order):
+                    w, pred, v = fns.pass_chunk(
+                        w, pred, active, blk, labels, mask
+                    )
+                    viol = jnp.maximum(viol, v)
             if cfg.solver.kkt_filter_threshold > 0:
                 thr = cfg.solver.kkt_filter_threshold * max(float(viol), 1e-12)
-                active = kkt_fn(
-                    w, pred, active, blocks, labels, mask, jnp.float32(thr)
-                )
-            obj = float(obj_fn(w, pred, labels, mask))
+                if resident_blocks is not None:
+                    active = fns.kkt_resident(
+                        w, pred, active, resident_blocks,
+                        order.astype(np.int32), labels, mask, jnp.float32(thr),
+                    )
+                else:
+                    for blk in _chunks(order):
+                        active = fns.kkt_chunk(
+                            w, pred, active, blk, labels, mask, jnp.float32(thr)
+                        )
+            obj = float(fns.obj(w, pred, labels, mask))
             rel = (prev_obj - obj) / max(abs(prev_obj), 1e-12)
             nnz = int((np.asarray(w) != 0).sum())
             self.reporter.report(

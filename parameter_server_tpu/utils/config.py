@@ -67,6 +67,10 @@ class SolverConfig:
     # darlin-only:
     block_iters: int = 20
     feature_blocks: int = 16
+    # distributed darlin data residency: 0 keeps all packed blocks in HBM
+    # (device_put once); C > 0 streams C blocks at a time from the block
+    # cache (bounded memory; ref: SlotReader streams per block)
+    block_chunk: int = 0
     kkt_filter_threshold: float = 0.0  # 0 disables the KKT filter
     epsilon: float = 1e-4  # relative-objective stopping rule
 
