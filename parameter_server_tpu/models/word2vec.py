@@ -42,7 +42,8 @@ def sgns_train_step(
     out_rows = {k: jnp.take(v, out_ids, axis=0) for k, v in out_state.items()}
 
     loss, g_u, g_v = _sgns_weights_math(
-        in_up.weights(in_rows), out_up.weights(out_rows), B, K
+        in_up.weights(in_rows), out_up.weights(out_rows), B, K,
+        mask=batch.get("mask"),
     )
 
     d_in = in_up.delta(in_rows, g_u)
@@ -55,16 +56,22 @@ def sgns_train_step(
     return new_in, new_out, loss
 
 
-def _sgns_weights_math(u, v_flat, B, K):
+def _sgns_weights_math(u, v_flat, B, K, mask=None):
     """SGNS loss/grads from materialized weights, shared verbatim by the
     single-device and SPMD steps.
 
-    loss: -log sig(pos) - sum log sig(-neg), in softplus form."""
+    loss: -log sig(pos) - sum log sig(-neg), in softplus form.
+    mask: optional (B,) float — padded pairs (the streaming tail) get zero
+    loss AND zero gradient, so their (id 0) rows are never touched."""
     v_all = v_flat.reshape(B, 1 + K, -1)  # (B, 1+K, d)
     logits = jnp.einsum("bd,bkd->bk", u, v_all)  # (B, 1+K)
     labels = jnp.concatenate([jnp.ones((B, 1)), jnp.zeros((B, K))], axis=1)
-    loss = jnp.sum(jax.nn.softplus(logits) - labels * logits)
+    terms = jax.nn.softplus(logits) - labels * logits
     err = jax.nn.sigmoid(logits) - labels  # (B, 1+K)
+    if mask is not None:
+        terms = terms * mask[:, None]
+        err = err * mask[:, None]
+    loss = jnp.sum(terms)
     g_u = jnp.einsum("bk,bkd->bd", err, v_all)  # (B, d)
     g_v = (err[:, :, None] * u[:, None, :]).reshape(B * (1 + K), -1)
     return loss, g_u, g_v
@@ -111,7 +118,7 @@ def make_w2v_spmd_train_step(
         ).reshape(-1)
         u_w = lax.psum(_local_pull(in_up, in_l, center, shard), "kv")
         v_w = lax.psum(_local_pull(out_up, out_l, out_ids, shard), "kv")
-        loss, g_u, g_v = _sgns_weights_math(u_w, v_w, B, K)
+        loss, g_u, g_v = _sgns_weights_math(u_w, v_w, B, K, mask=b.get("mask"))
         if push_mode == "aggregate":
             new_in = _local_push_aggregate(in_up, in_l, center, g_u, shard)
             new_out = _local_push_aggregate(out_up, out_l, out_ids, g_v, shard)
@@ -144,25 +151,234 @@ def make_w2v_spmd_train_step(
 def _stack_w2v_batches(batches: list[dict], mesh) -> dict:
     """Stack D per-worker pair batches on a leading axis, sharded over
     "data" (negatives keep their trailing (B, K) shape)."""
+    return _place_w2v_stacked(
+        {k: np.stack([b[k] for b in batches]) for k in batches[0]}, mesh
+    )
+
+
+def _place_w2v_stacked(stacked: dict, mesh) -> dict:
+    """Place already-stacked (D, ...) host arrays sharded over "data"."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     sh = NamedSharding(mesh, P("data"))
-    return {
-        k: jax.device_put(np.stack([b[k] for b in batches]), sh)
-        for k in batches[0]
-    }
+    return {k: jax.device_put(v, sh) for k, v in stacked.items()}
 
 
 class NegativeSampler:
-    """unigram^0.75 table sampler (word2vec's standard trick)."""
+    """unigram^0.75 sampler (word2vec's standard trick): inverse-CDF via
+    searchsorted — O(log V) per draw, no per-call table rebuild (rng.choice
+    with p re-normalizes the whole distribution every call)."""
 
     def __init__(self, counts: np.ndarray, power: float = 0.75, seed: int = 0):
         p = np.asarray(counts, dtype=np.float64) ** power
         self.p = p / p.sum()
+        self._cdf = np.cumsum(self.p)
+        self._cdf[-1] = 1.0
         self.rng = np.random.default_rng(seed)
 
     def sample(self, shape) -> np.ndarray:
-        return self.rng.choice(len(self.p), size=shape, p=self.p)
+        u = self.rng.random(size=shape)
+        return np.searchsorted(self._cdf, u, side="right")
+
+
+# ---------------------------------------------------------------------------
+# Streaming corpus path (BASELINE's "1B-word corpus" spec): skip-gram pairs
+# are NEVER materialized for the whole corpus. Token files flow through a
+# WorkloadPool (the reference's file-shard assignment), each worker stream
+# reads blocks of tokens, windows them into pairs, block-shuffles, and
+# emits fixed-size batches — host memory is bounded by one block's pairs
+# (~ 2 * window * block_tokens), independent of corpus size.
+# ---------------------------------------------------------------------------
+
+
+def _window_pairs(
+    tokens: np.ndarray, window: int, skip_prefix: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """(center, context) pairs within ``window``; with skip_prefix = W,
+    pairs whose LATER token falls inside the first W tokens are dropped —
+    the cross-block carry trick: prepend the previous block's last W
+    tokens, and boundary-crossing pairs appear exactly once."""
+    cs, xs = [], []
+    for off in range(1, window + 1):
+        a, b = tokens[:-off], tokens[off:]  # pair i: (i, i + off)
+        lo = max(0, skip_prefix - off)  # keep i + off >= skip_prefix
+        cs.append(a[lo:])
+        xs.append(b[lo:])
+        cs.append(b[lo:])
+        xs.append(a[lo:])
+    if not cs:
+        z = np.zeros(0, dtype=tokens.dtype)
+        return z, z
+    return np.concatenate(cs), np.concatenate(xs)
+
+
+def iter_token_blocks(path: str, block_tokens: int = 1 << 20):
+    """Stream int token-id blocks from a corpus file: ``.npy`` arrays are
+    mmap'd and sliced; anything else is whitespace-separated integer text
+    read in bounded chunks (partial tokens carried across chunk reads)."""
+    if str(path).endswith(".npy"):
+        arr = np.load(path, mmap_mode="r")
+        for lo in range(0, len(arr), block_tokens):
+            yield np.asarray(arr[lo : lo + block_tokens], dtype=np.int64)
+        return
+    carry = b""
+    pending: list[np.ndarray] = []
+    n_pending = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 22)
+            if not chunk:
+                break
+            chunk = carry + chunk
+            cut = max(chunk.rfind(b" "), chunk.rfind(b"\n"), chunk.rfind(b"\t"))
+            if cut < 0:
+                carry = chunk
+                continue
+            carry = chunk[cut + 1 :]
+            toks = chunk[:cut].split()
+            if toks:
+                pending.append(np.array(toks, dtype=np.int64))
+                n_pending += len(pending[-1])
+            if n_pending >= block_tokens:
+                # concatenate ONCE per read chunk and yield fixed-offset
+                # slices (re-concatenating the tail per block would memcpy
+                # the remainder O(blocks) times)
+                flat = np.concatenate(pending)
+                usable = len(flat) // block_tokens * block_tokens
+                for off in range(0, usable, block_tokens):
+                    yield flat[off : off + block_tokens]
+                rest = flat[usable:]
+                pending, n_pending = ([rest], len(rest)) if len(rest) else ([], 0)
+    if carry.strip():
+        pending.append(np.array([int(carry)], dtype=np.int64))
+        n_pending += 1
+    if n_pending:
+        yield np.concatenate(pending)
+
+
+def count_vocab(
+    files: list[str], vocab_size: int, block_tokens: int = 1 << 20
+) -> np.ndarray:
+    """Streaming unigram counts over corpus files (the sampler's input)."""
+    counts = np.zeros(vocab_size, dtype=np.int64)
+    for f in files:
+        for block in iter_token_blocks(str(f), block_tokens):
+            counts += np.bincount(block, minlength=vocab_size)
+    return counts
+
+
+class PairStream:
+    """One worker's streaming pair source: drains corpus files from the
+    pool, windows token blocks into block-shuffled (center, context) pair
+    batches with negatives. Compatible with data.pipeline.PrefetchPipeline
+    (``next_batch`` / ``_empty``)."""
+
+    def __init__(
+        self,
+        worker_id: int,
+        pool,  # WorkloadPool of corpus file paths
+        *,
+        window: int,
+        batch_size: int,
+        num_negatives: int,
+        sampler: NegativeSampler,
+        block_tokens: int = 1 << 20,
+        seed: int = 0,
+    ):
+        self.worker_id = worker_id
+        self.pool = pool
+        self.window = window
+        self.batch_size = batch_size
+        self.K = num_negatives
+        self.sampler = sampler
+        self.block_tokens = block_tokens
+        self.rng = np.random.default_rng(seed * 100003 + worker_id * 7919)
+        self._blocks = None  # token-block iterator of the current file
+        self._current: str | None = None
+        self._tail: np.ndarray | None = None  # last W tokens of prev block
+        self._buf_c = np.zeros(0, dtype=np.int64)
+        self._buf_x = np.zeros(0, dtype=np.int64)
+        self.max_buffered = 0  # observability: peak pairs held
+
+    def _next_block(self) -> np.ndarray | None:
+        while True:
+            if self._blocks is not None:
+                block = next(self._blocks, None)
+                if block is not None:
+                    return block
+                if self._current is not None:
+                    self.pool.finish(self._current)
+                self._blocks = None
+                self._current = None
+                self._tail = None  # windows never span files
+            w = self.pool.fetch(self.worker_id)
+            if w is None:
+                return None
+            self._current = w
+            self._blocks = iter_token_blocks(str(w), self.block_tokens)
+
+    def _fill(self) -> None:
+        if len(self._buf_c) >= self.batch_size:
+            return
+        new_c, new_x = [], []
+        n_new = 0
+        while len(self._buf_c) + n_new < self.batch_size:
+            block = self._next_block()
+            if block is None:
+                break
+            if self._tail is not None and len(self._tail):
+                t = np.concatenate([self._tail, block])
+                c, x = _window_pairs(t, self.window, skip_prefix=len(self._tail))
+            else:
+                t = block
+                c, x = _window_pairs(block, self.window)
+            # carry the last W tokens of the CONCATENATED stream (a block
+            # shorter than W must not truncate the window)
+            self._tail = t[-self.window :].copy()
+            if len(c):
+                new_c.append(c)
+                new_x.append(x)
+                n_new += len(c)
+        if n_new:
+            # block shuffle: ONE permutation over (buffer + new pairs) per
+            # fill — same uniform shuffle as permuting per appended block,
+            # without re-copying the growing buffer k times
+            c = np.concatenate([self._buf_c, *new_c])
+            x = np.concatenate([self._buf_x, *new_x])
+            perm = self.rng.permutation(len(c))
+            self._buf_c, self._buf_x = c[perm], x[perm]
+            self.max_buffered = max(self.max_buffered, len(self._buf_c))
+
+    def next_batch(self) -> dict | None:
+        self._fill()
+        n = min(len(self._buf_c), self.batch_size)
+        if n == 0:
+            return None
+        b = self._make(self._buf_c[:n], self._buf_x[:n])
+        self._buf_c = self._buf_c[n:]
+        self._buf_x = self._buf_x[n:]
+        return b
+
+    def _make(self, c: np.ndarray, x: np.ndarray) -> dict:
+        bs = self.batch_size
+        out = {
+            "center": np.zeros(bs, dtype=np.int32),
+            "context": np.zeros(bs, dtype=np.int32),
+            "negatives": self.sampler.sample((bs, self.K)).astype(np.int32),
+            "mask": np.zeros(bs, dtype=np.float32),
+        }
+        out["center"][: len(c)] = c
+        out["context"][: len(c)] = x
+        out["mask"][: len(c)] = 1.0
+        return out
+
+    def _empty(self) -> dict:
+        return {
+            "center": np.zeros(self.batch_size, dtype=np.int32),
+            "context": np.zeros(self.batch_size, dtype=np.int32),
+            "negatives": np.zeros((self.batch_size, self.K), dtype=np.int32),
+            "mask": np.zeros(self.batch_size, dtype=np.float32),
+        }
 
 
 class Word2Vec:
@@ -290,6 +506,99 @@ class Word2Vec:
             examples=n, objv=mean, ex_per_sec=n / max(time.perf_counter() - t0, 1e-9)
         )
         return mean
+
+    def train_files(
+        self,
+        files: list[str],
+        batch_size: int = 8192,
+        epochs: int = 1,
+        block_tokens: int = 1 << 20,
+        seed: int = 0,
+        counts: np.ndarray | None = None,
+        pipeline_depth: int = 2,
+    ) -> float:
+        """Streaming corpus training (BASELINE's 1B-word operating point):
+        corpus file shards flow through a WorkloadPool to one PairStream
+        per data shard; pair batches are built on PrefetchPipeline threads
+        and dispatched SSP-gated — pairs are never materialized corpus-wide
+        and host memory is bounded by blocks, not the corpus.
+
+        counts: pre-computed unigram counts (else one cheap streaming
+        counting pass feeds the negative sampler)."""
+        from parameter_server_tpu.parallel.workload import WorkloadPool
+
+        if counts is None:
+            counts = count_vocab(files, self.vocab_size, block_tokens)
+        D = self.mesh.shape["data"] if self.mesh is not None else 1
+        total_loss, n_pairs = 0.0, 0
+        t0 = time.perf_counter()
+        for ep in range(epochs):
+            pool = WorkloadPool([str(f) for f in files])
+            streams = [
+                PairStream(
+                    w, pool,
+                    window=self.window, batch_size=batch_size,
+                    num_negatives=self.K,
+                    sampler=NegativeSampler(counts, seed=seed + 31 * ep + w),
+                    block_tokens=block_tokens, seed=seed + 997 * ep,
+                )
+                for w in range(D)
+            ]
+            loss, n = self._train_stream(streams, pipeline_depth)
+            total_loss += loss
+            n_pairs += n
+        mean = total_loss / max(n_pairs, 1)
+        self.reporter.report(
+            examples=n_pairs, objv=mean,
+            ex_per_sec=n_pairs / max(time.perf_counter() - t0, 1e-9),
+        )
+        return mean
+
+    def _train_stream(self, streams, pipeline_depth: int) -> tuple[float, int]:
+        """SSP-gated dispatch of streamed pair batches; returns
+        (sum loss, real pairs)."""
+        from collections import deque
+
+        from parameter_server_tpu.data.pipeline import PrefetchPipeline
+
+        def prepare(batches: list[dict]) -> tuple[dict, int]:
+            stacked = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+            return stacked, int(sum(b["mask"].sum() for b in batches))
+
+        in_flight: deque = deque()
+        total_loss, n_pairs = 0.0, 0
+
+        def _retire(entry) -> None:
+            nonlocal total_loss
+            total_loss += float(entry[1])
+
+        step_i = 0
+        with PrefetchPipeline(streams, prepare, depth=max(1, pipeline_depth)) as p:
+            while True:
+                target = step_i - self.max_delay - 1
+                while in_flight and in_flight[0][0] <= target:
+                    _retire(in_flight.popleft())
+                item = p.get()
+                if item is None:
+                    break
+                stacked, n = item
+                if self.mesh is not None:
+                    batch = _place_w2v_stacked(stacked, self.mesh)
+                    self.in_state, self.out_state, loss = self._spmd_step(
+                        self.in_state, self.out_state, batch
+                    )
+                else:
+                    b = {k: jnp.asarray(v[0]) for k, v in stacked.items()}
+                    self.in_state, self.out_state, loss = sgns_train_step(
+                        self.in_up, self.out_up,
+                        self.in_state, self.out_state, b,
+                    )
+                in_flight.append((step_i, loss))
+                n_pairs += n
+                step_i += 1
+            while in_flight:
+                _retire(in_flight.popleft())
+        return total_loss, n_pairs
 
     def embeddings(self) -> np.ndarray:
         return np.asarray(self.in_up.weights(self.in_state))

@@ -138,3 +138,110 @@ class TestWideDeep:
         for _ in range(3):
             app.train(train)
         assert app.evaluate(test)["auc"] < 0.6
+
+
+class TestWord2VecStreaming:
+    """The streaming corpus path: file shards -> WorkloadPool ->
+    PairStream blocks -> SSP-gated dispatch; pairs never materialized
+    corpus-wide (BASELINE's 1B-word operating point)."""
+
+    def _topic_corpus(self, n_chunks=600, seed=0):
+        rng = np.random.default_rng(seed)
+        chunks = []
+        for _ in range(n_chunks):
+            topic = rng.integers(0, 2)
+            chunks.append(rng.integers(0, 5, size=8) + 5 * topic)
+        return np.concatenate(chunks)
+
+    def test_window_pairs_match_make_pairs(self):
+        from parameter_server_tpu.models.word2vec import _window_pairs
+
+        corpus = np.random.default_rng(1).integers(0, 50, 500)
+        w2v = Word2Vec(vocab_size=50, dim=4, reporter=quiet())
+        ref_c, ref_x = w2v.make_pairs(corpus)
+        c, x = _window_pairs(corpus, w2v.window)
+        ref = sorted(zip(ref_c.tolist(), ref_x.tolist()))
+        got = sorted(zip(c.tolist(), x.tolist()))
+        assert got == ref
+
+    def test_stream_covers_exactly_the_corpus_pairs(self, tmp_path):
+        """Every window pair appears exactly once across streamed batches,
+        including pairs crossing block boundaries; no duplicates from the
+        carry trick."""
+        from parameter_server_tpu.models.word2vec import (
+            NegativeSampler,
+            PairStream,
+            _window_pairs,
+        )
+        from parameter_server_tpu.parallel.workload import WorkloadPool
+
+        rng = np.random.default_rng(3)
+        corpus = rng.integers(0, 30, 997)  # deliberately not block-aligned
+        f = tmp_path / "corpus.txt"
+        f.write_text(" ".join(map(str, corpus)))
+        pool = WorkloadPool([str(f)])
+        s = PairStream(
+            0, pool, window=3, batch_size=64, num_negatives=2,
+            sampler=NegativeSampler(np.bincount(corpus, minlength=30), seed=0),
+            block_tokens=100,
+        )
+        got = []
+        while (b := s.next_batch()) is not None:
+            m = b["mask"] > 0
+            got += list(zip(b["center"][m].tolist(), b["context"][m].tolist()))
+        ref_c, ref_x = _window_pairs(corpus, 3)
+        assert sorted(got) == sorted(zip(ref_c.tolist(), ref_x.tolist()))
+
+    def test_memory_bounded_by_blocks(self, tmp_path):
+        """A corpus far larger than the block size streams with the pair
+        buffer bounded by ~2*window*block_tokens, not corpus pairs."""
+        from parameter_server_tpu.models.word2vec import (
+            NegativeSampler,
+            PairStream,
+        )
+        from parameter_server_tpu.parallel.workload import WorkloadPool
+
+        n, block = 200_000, 2_000
+        corpus = np.random.default_rng(5).integers(0, 100, n)
+        f = tmp_path / "big.npy"
+        np.save(f, corpus)
+        pool = WorkloadPool([str(f)])
+        s = PairStream(
+            0, pool, window=2, batch_size=256, num_negatives=2,
+            sampler=NegativeSampler(np.bincount(corpus, minlength=100), seed=0),
+            block_tokens=block,
+        )
+        n_pairs = 0
+        while (b := s.next_batch()) is not None:
+            n_pairs += int((b["mask"] > 0).sum())
+        total_pairs = 2 * (2 * n - 3)  # sum over off in {1,2} of 2*(n-off)
+        assert n_pairs == total_pairs
+        # buffer peak: about one block's pairs (+ carry + an open batch)
+        assert s.max_buffered < 2 * 2 * (block + 256 + 4)
+        assert s.max_buffered < total_pairs / 20
+
+    def test_streaming_quality_matches_in_memory(self, tmp_path):
+        """Same topic-structure bar as the in-memory test, trained from
+        corpus FILES through the streaming path on the (2, 1) mesh."""
+        from parameter_server_tpu.parallel import make_mesh
+
+        corpus = self._topic_corpus()
+        paths = []
+        for i in range(2):
+            p = tmp_path / f"part{i}.txt"
+            half = corpus[i * len(corpus) // 2 : (i + 1) * len(corpus) // 2]
+            p.write_text(" ".join(map(str, half)))
+            paths.append(str(p))
+        w2v = Word2Vec(vocab_size=16, dim=16, eta=0.5, num_negatives=4,
+                       window=2, reporter=quiet(), mesh=make_mesh(2, 1),
+                       max_delay=1)
+        first = w2v.train_files(paths, batch_size=2048, epochs=1,
+                                block_tokens=4096, seed=0)
+        last = first
+        for ep in range(1, 8):
+            last = w2v.train_files(paths, batch_size=2048, epochs=1,
+                                   block_tokens=4096, seed=ep)
+        assert last < first
+        within = np.mean([w2v.similarity(0, i) for i in range(1, 5)])
+        across = np.mean([w2v.similarity(0, i) for i in range(5, 10)])
+        assert within > across + 0.3, (within, across)
