@@ -167,15 +167,14 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
         from parameter_server_tpu.parallel.trainer import PodTrainer
         from parameter_server_tpu.utils.checkpoint import dump_weights_text
 
-        # the config's data_shards is the GLOBAL data axis, honored
-        # verbatim (multi-host runs must set it to a multiple of
-        # num_processes; runtime.init validates)
+        # the config's parallel section is the single source of truth for
+        # the mesh shape (multi-host runs must set data_shards to a
+        # multiple of num_processes; runtime.init validates)
         rt = runtime_mod.init(
             args.coordinator or None,
             args.num_processes,
             args.process_id,
-            kv_shards=cfg.parallel.kv_shards,
-            data_shards=cfg.parallel.data_shards,
+            cfg=cfg,
         )
         trainer = PodTrainer(cfg, runtime=rt)
         if args.resume:

@@ -263,6 +263,12 @@ def count_vocab(
     counts = np.zeros(vocab_size, dtype=np.int64)
     for f in files:
         for block in iter_token_blocks(str(f), block_tokens):
+            if len(block) and (block.min() < 0 or block.max() >= vocab_size):
+                bad = block[(block < 0) | (block >= vocab_size)][0]
+                raise ValueError(
+                    f"corpus file {f!r} has token id {int(bad)} outside "
+                    f"[0, vocab_size={vocab_size})"
+                )
             counts += np.bincount(block, minlength=vocab_size)
     return counts
 
@@ -452,7 +458,7 @@ class Word2Vec:
         steps stay in flight and losses are read back only on retirement —
         never a per-batch device sync (the async windowed pattern of
         models/linear.py, ref: the worker Executor's wait_time bound)."""
-        from collections import deque
+        from parameter_server_tpu.parallel.ssp import DispatchWindow
 
         counts = np.bincount(corpus, minlength=self.vocab_size)
         sampler = NegativeSampler(counts, seed=seed)
@@ -462,22 +468,19 @@ class Word2Vec:
         D = self.mesh.shape["data"] if self.mesh is not None else 1
         global_bs = batch_size * D
 
-        in_flight: deque = deque()  # (step, loss_array, n_pairs)
         total_loss, n = 0.0, 0
         t0 = time.perf_counter()
 
-        def _retire(entry) -> None:
+        def _retire(step: int, loss_arr) -> None:
             nonlocal total_loss
-            _, loss_arr, _cnt = entry
             total_loss += float(loss_arr)  # sync point, bounded by the gate
 
+        gate = DispatchWindow(self.max_delay, _retire)
         step_i = 0
         for s in range(0, len(order) - global_bs + 1, global_bs):
             sel = order[s : s + global_bs]
             # SSP gate: retire steps <= t - tau - 1 before dispatching t
-            target = step_i - self.max_delay - 1
-            while in_flight and in_flight[0][0] <= target:
-                _retire(in_flight.popleft())
+            gate.gate(step_i)
             if self.mesh is not None:
                 subs = [
                     self._make_batch(
@@ -496,11 +499,10 @@ class Word2Vec:
                 self.in_state, self.out_state, loss = sgns_train_step(
                     self.in_up, self.out_up, self.in_state, self.out_state, batch
                 )
-            in_flight.append((step_i, loss, len(sel)))
+            gate.add(step_i, loss)
             n += len(sel)
             step_i += 1
-        while in_flight:
-            _retire(in_flight.popleft())
+        gate.drain()
         mean = total_loss / max(n, 1)
         self.reporter.report(
             examples=n, objv=mean, ex_per_sec=n / max(time.perf_counter() - t0, 1e-9)
@@ -556,29 +558,47 @@ class Word2Vec:
 
     def _train_stream(self, streams, pipeline_depth: int) -> tuple[float, int]:
         """SSP-gated dispatch of streamed pair batches; returns
-        (sum loss, real pairs)."""
-        from collections import deque
+        (sum loss, real pairs). pipeline_depth=0 builds batches serially
+        inline (deterministic stream->file assignment, no threads) — same
+        contract as cfg.data.pipeline_depth in PodTrainer."""
+        import contextlib
 
         from parameter_server_tpu.data.pipeline import PrefetchPipeline
+        from parameter_server_tpu.parallel.ssp import DispatchWindow
 
         def prepare(batches: list[dict]) -> tuple[dict, int]:
             stacked = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
             return stacked, int(sum(b["mask"].sum() for b in batches))
 
-        in_flight: deque = deque()
         total_loss, n_pairs = 0.0, 0
 
-        def _retire(entry) -> None:
+        def _retire(step: int, loss_arr) -> None:
             nonlocal total_loss
-            total_loss += float(entry[1])
+            total_loss += float(loss_arr)
+
+        gate = DispatchWindow(self.max_delay, _retire)
+        if pipeline_depth > 0:
+            pipeline = PrefetchPipeline(streams, prepare, depth=pipeline_depth)
+            next_item = pipeline.get
+        else:
+            pipeline = contextlib.nullcontext()
+
+            def next_item():
+                batches = [s.next_batch() for s in streams]
+                if all(b is None for b in batches):
+                    return None
+                return prepare(
+                    [
+                        b if b is not None else streams[i]._empty()
+                        for i, b in enumerate(batches)
+                    ]
+                )
 
         step_i = 0
-        with PrefetchPipeline(streams, prepare, depth=max(1, pipeline_depth)) as p:
+        with pipeline:
             while True:
-                target = step_i - self.max_delay - 1
-                while in_flight and in_flight[0][0] <= target:
-                    _retire(in_flight.popleft())
-                item = p.get()
+                gate.gate(step_i)
+                item = next_item()
                 if item is None:
                     break
                 stacked, n = item
@@ -593,11 +613,10 @@ class Word2Vec:
                         self.in_up, self.out_up,
                         self.in_state, self.out_state, b,
                     )
-                in_flight.append((step_i, loss))
+                gate.add(step_i, loss)
                 n_pairs += n
                 step_i += 1
-            while in_flight:
-                _retire(in_flight.popleft())
+            gate.drain()
         return total_loss, n_pairs
 
     def embeddings(self) -> np.ndarray:

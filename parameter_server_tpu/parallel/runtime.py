@@ -182,14 +182,29 @@ def init(
     process_id: int | None = None,
     kv_shards: int = 1,
     data_shards: int | None = None,
+    cfg=None,
 ) -> Runtime:
     """Bootstrap this process into the pod and build the global mesh.
 
     Single-host: call with no coordinator (or num_processes=1). Multi-host:
     every process calls with the same coordinator address and its own
     process_id — the TPU analog of `-scheduler ip:port -my_node ...`.
+
+    cfg: a PSConfig — when given, the mesh shape comes from
+    ``cfg.parallel`` and the explicit kv_shards/data_shards kwargs must
+    not be used (ONE source of truth; PodTrainer re-checks its cfg
+    against the runtime mesh and fails loudly on mismatch).
     """
     import jax
+
+    if cfg is not None:
+        if kv_shards != 1 or data_shards is not None:
+            raise ValueError(
+                "pass EITHER cfg (mesh shape from cfg.parallel) OR explicit "
+                "kv_shards/data_shards — not both"
+            )
+        kv_shards = cfg.parallel.kv_shards
+        data_shards = cfg.parallel.data_shards
 
     if coordinator_addr is None and (num_processes or 1) > 1:
         # the mirror of the guard below: N processes launched without a

@@ -16,6 +16,48 @@ the clock gives the bound."""
 from __future__ import annotations
 
 import threading
+from collections import deque
+from collections.abc import Callable
+from typing import Any
+
+
+class DispatchWindow:
+    """The host-side bounded async-dispatch window every trainer shares
+    (the single home of the gate arithmetic — PodTrainer, the in-memory
+    word2vec epoch, and the streaming word2vec path all retire through
+    here, so the wait_time semantics can't silently diverge).
+
+    Protocol, for step t about to be dispatched:
+        window.gate(t)          # retire every entry <= t - max_delay - 1
+        ... dispatch step t ...
+        window.add(t, entry)
+    and at a sync point: window.drain().
+
+    ``retire(step, entry)`` is the caller's completion hook (it may block
+    on device results — that block IS the SSP bound taking effect).
+    """
+
+    def __init__(self, max_delay: int, retire: Callable[[int, Any], None]):
+        self.max_delay = max_delay
+        self._retire = retire
+        self._q: deque[tuple[int, Any]] = deque()
+        self.max_inflight = 0  # observability: peak run-ahead reached
+
+    def gate(self, step: int) -> None:
+        target = step - self.max_delay - 1
+        while self._q and self._q[0][0] <= target:
+            self._retire(*self._q.popleft())
+
+    def add(self, step: int, entry: Any) -> None:
+        self._q.append((step, entry))
+        self.max_inflight = max(self.max_inflight, len(self._q))
+
+    def drain(self) -> None:
+        while self._q:
+            self._retire(*self._q.popleft())
+
+    def __len__(self) -> int:
+        return len(self._q)
 
 
 class SSPClock:

@@ -59,6 +59,39 @@ def linear_step_traffic(
     return StepTraffic(pull, push, pull + push)
 
 
+@dataclass(frozen=True)
+class WireTraffic:
+    """Estimated bytes for ONE pull+push round against one shard server
+    over the TCP wire tier (payloads only; each of the 4 frames adds
+    ~8 B length prefix + a small JSON header on top)."""
+
+    out_bytes: int  # worker -> server: pull request + push request
+    in_bytes: int  # server -> worker: pull reply (+ push ack header)
+
+
+def wire_step_traffic(
+    num_unique: int,
+    vdim: int = 1,
+    key_bytes: int = 4,
+    value_bytes: int = 4,
+    send_keys: bool = True,
+) -> WireTraffic:
+    """Payload traffic of one wire-tier worker step (multislice tier):
+    the batch's key list rides the wire ONCE per step — the pull sends it
+    and primes the key-caching signature, so the same step's push is
+    sig-only; the pull reply carries U weights and the push carries U
+    gradients. send_keys=False models a fully warm cache (repeated key
+    set): both calls are sig-only. Reconciled against the MEASURED
+    RpcClient byte counters in tests/test_multislice.py — the reference's
+    Postoffice counters report exactly this quantity per filter stage."""
+    u = num_unique
+    keys = u * key_bytes if send_keys else 0
+    return WireTraffic(
+        out_bytes=keys + u * vdim * value_bytes,
+        in_bytes=u * vdim * value_bytes,
+    )
+
+
 def quantization_savings(num_bytes: int, value_bytes: int = 4) -> float:
     """Fraction of push payload saved by the fixed-point codec on DCN
     (ref: the filter savings report)."""

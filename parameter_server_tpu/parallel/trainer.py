@@ -19,7 +19,6 @@ host batch-prep with device compute."""
 from __future__ import annotations
 
 import time
-from collections import deque
 from collections.abc import Iterator
 
 import jax
@@ -37,7 +36,7 @@ from parameter_server_tpu.parallel.spmd import (
     make_spmd_train_step,
     stack_batches,
 )
-from parameter_server_tpu.parallel.ssp import SSPClock
+from parameter_server_tpu.parallel.ssp import DispatchWindow, SSPClock
 from parameter_server_tpu.parallel.workload import WorkloadPool
 from parameter_server_tpu.utils.config import PSConfig
 from parameter_server_tpu.utils.metrics import ProgressReporter
@@ -109,6 +108,19 @@ class PodTrainer:
                 local_data_shards=m.shape["data"],
             )
         self.mesh = self.runtime.mesh
+        # one source of truth (ref: the scheduler validating -num_servers /
+        # -num_workers against the registered cluster): a cfg whose
+        # parallel section disagrees with the mesh it runs on must fail
+        # loudly, not train silently under different sharding
+        got = (self.mesh.shape["data"], self.mesh.shape["kv"])
+        want = (cfg.parallel.data_shards, cfg.parallel.kv_shards)
+        if (mesh is not None or runtime is not None) and got != want:
+            raise ValueError(
+                f"cfg.parallel says (data_shards, kv_shards)={want} but the "
+                f"provided {'runtime' if runtime is not None else 'mesh'} is "
+                f"{got}; update cfg.parallel (or build the runtime with "
+                "runtime.init(..., cfg=cfg)) so both agree"
+            )
         self.data_shards = self.mesh.shape["data"]
         # this process feeds only its own data rows (multi-host contract)
         self.local_data_shards = self.runtime.local_data_shards
@@ -128,6 +140,9 @@ class PodTrainer:
             num_workers=1, max_delay=max(cfg.solver.max_delay, 0)
         )
         self.examples_seen = 0
+        # observability: peak dispatch run-ahead (the SSP/async-overlap
+        # depth actually reached; == max_delay + 1 when the gate binds)
+        self.max_inflight = 0
         # observability (SURVEY §5.1): jax.profiler traces on demand + the
         # static per-step collective-byte estimate in every report (the
         # reference's Postoffice byte counters; reconcile the estimate
@@ -197,7 +212,6 @@ class PodTrainer:
         return stacked, n, labels, counts
 
     def _train_epoch(self, streams: list[_WorkerStream], report_every: int) -> dict:
-        in_flight: deque = deque()  # (step, loss, examples, probs, labels, n)
         window: list = []
         n_since = 0
         t0 = time.perf_counter()
@@ -205,16 +219,18 @@ class PodTrainer:
         last: dict = {}
         drained = False  # a retired step reported 0 pod-wide examples
 
-        def _retire(entry) -> None:
+        def _retire(step: int, entry) -> None:
             nonlocal drained
-            _, loss_arr, examples_arr, probs, labels, n = entry
+            loss_arr, examples_arr, probs, labels, n = entry
             jax.block_until_ready(loss_arr)
-            self.clock.finish(0, entry[0])
+            self.clock.finish(0, step)
             if float(examples_arr) == 0.0:
                 drained = True
             window.append(
                 (float(loss_arr), self.runtime.localize_data(probs), labels)
             )
+
+        gate = DispatchWindow(self.clock.max_delay, _retire)
 
         # Host input pipeline (ref: learner/sgd.h parser threads): batch
         # builds run on background threads; the loop below only pops
@@ -259,9 +275,7 @@ class PodTrainer:
         try:
             while True:
                 # SSP gate: block until step (t - tau - 1) fully completed
-                target = step_idx - self.clock.max_delay - 1
-                while in_flight and in_flight[0][0] <= target:
-                    _retire(in_flight.popleft())
+                gate.gate(step_idx)
                 if drained:
                     break
                 stacked_np, n, labels, mask_counts = _next_item()
@@ -269,20 +283,20 @@ class PodTrainer:
                 self.state, out = self.step_fn(self.state, stacked)
                 self.examples_seen += n
                 n_since += n
-                in_flight.append(
+                gate.add(
+                    step_idx,
                     (
-                        step_idx, out["loss_sum"], out["examples"],
-                        out["probs"], (labels, mask_counts), n,
-                    )
+                        out["loss_sum"], out["examples"], out["probs"],
+                        (labels, mask_counts), n,
+                    ),
                 )
+                self.max_inflight = max(self.max_inflight, gate.max_inflight)
                 step_idx += 1
                 if step_idx % report_every == 0:
-                    while in_flight:
-                        _retire(in_flight.popleft())
+                    gate.drain()
                     last = self._flush(window, n_since, t0)
                     window, n_since, t0 = [], 0, time.perf_counter()
-            while in_flight:
-                _retire(in_flight.popleft())
+            gate.drain()
         finally:
             if pipeline is not None:
                 pipeline.close()
@@ -365,15 +379,28 @@ class PodTrainer:
         builder = eval_builder(self.cfg, key_mode)
         reader = MinibatchReader(files, self.cfg.data.format, builder)
         ys, ps = [], []
-        for b in reader:
-            batches = [b] + [
-                _pad_like(builder) for _ in range(self.data_shards - 1)
+
+        def _flush(group: list[CSRBatch]) -> None:
+            # fill every data shard with real batches (D at a time); only
+            # the tail group pads with inert batches
+            batches = group + [
+                _pad_like(builder) for _ in range(self.data_shards - len(group))
             ]
             probs = np.asarray(
                 self.predict_fn(self.state, stack_batches(batches, self.mesh))
             )
-            ps.append(probs[0, : b.num_examples])
-            ys.append(b.labels[: b.num_examples])
+            for d, b in enumerate(group):
+                ps.append(probs[d, : b.num_examples])
+                ys.append(b.labels[: b.num_examples])
+
+        group: list[CSRBatch] = []
+        for b in reader:
+            group.append(b)
+            if len(group) == self.data_shards:
+                _flush(group)
+                group = []
+        if group:
+            _flush(group)
         y = np.concatenate(ys)
         p = np.concatenate(ps)
         return {"auc": M.auc(y, p), "logloss": M.logloss(y, p), "examples": len(y)}

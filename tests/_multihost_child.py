@@ -21,8 +21,8 @@ def main() -> None:
     from parameter_server_tpu.parallel.trainer import PodTrainer
     from parameter_server_tpu.utils.config import PSConfig, load_config
 
-    rt = runtime.init(coord, nprocs, pid, kv_shards=2)
     cfg = load_config(f"{workdir}/app.json")
+    rt = runtime.init(coord, nprocs, pid, cfg=cfg)
     files = [f"{workdir}/part-{i}.libsvm" for i in range(4)]
     val = [f"{workdir}/val.libsvm"]
 

@@ -50,6 +50,9 @@ def test_two_host_pod_trains_to_auc_parity(tmp_path):
         },
         "solver": {"algo": "ftrl", "minibatch": 128, "max_delay": 1, "epochs": 4},
         "penalty": {"lambda_l1": 0.05},
+        # single source of truth for the mesh shape: the children build
+        # their runtime with runtime.init(..., cfg=cfg)
+        "parallel": {"data_shards": 4, "kv_shards": 2},
     }
     (tmp_path / "app.json").write_text(json.dumps(cfg))
 
