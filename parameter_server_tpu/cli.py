@@ -68,6 +68,11 @@ def _build_parser() -> argparse.ArgumentParser:
         help="routable hostname published to the coordinator "
         "(defaults to bind_host)",
     )
+    nd.add_argument(
+        "--ckpt_dir", default="",
+        help="server recovery dir: resume this range's dump if present; "
+        "periodic dumps per [fault] server_ckpt_interval_s",
+    )
 
     la = sub.add_parser(
         "launch", help="spawn a local multi-process run (ref: script/local.sh)"
@@ -253,6 +258,7 @@ def main(argv: list[str] | None = None) -> int:
             cfg, args.role, args.rank, args.scheduler,
             args.num_servers, args.num_workers, args.model_out,
             bind_host=args.bind_host, advertise_host=args.advertise_host,
+            ckpt_dir=args.ckpt_dir,
         )
         if out is None:  # servers/workers exit silently; scheduler reports
             return 0

@@ -122,6 +122,8 @@ class ShardServer:
         self._key_cache = _LruSigs()  # (worker, sig) -> key array
         self._lock = threading.Lock()
         self._ctr_lock = threading.Lock()  # counters bumped by conn threads
+        self._ckpt_write_lock = threading.Lock()  # one dump writer at a time
+        self._ckpt_thread: threading.Thread | None = None
         self.counters = {"pulls": 0, "pushes": 0, "cache_hits": 0, "need_keys": 0}
         if host in ("0.0.0.0", "::", "") and not advertise_host:
             raise ValueError(
@@ -147,6 +149,70 @@ class ShardServer:
         self.server.start()
         while not self.server._stop.wait(0.2):
             pass
+
+    # -- checkpoint/restart (ref: each server dumps its own key range;
+    # resume = reload the range before continuing) ------------------------
+
+    def _ckpt_path(self, ckpt_dir: str) -> str:
+        import os
+
+        r = self.range
+        return os.path.join(ckpt_dir, f"server-{r.begin}-{r.end}.npz")
+
+    def save_state(self, ckpt_dir: str) -> None:
+        """Atomic dump of this range's updater state (tmp + rename: a
+        crash mid-write never leaves a torn checkpoint at the final path;
+        writers serialize so the final shutdown dump can't interleave with
+        an in-flight periodic dump on the shared tmp file)."""
+        import os
+
+        with self._lock:
+            host = {k: np.asarray(v) for k, v in self.state.items()}
+        with self._ckpt_write_lock:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            path = self._ckpt_path(ckpt_dir)
+            tmp = path + ".tmp.npz"  # .npz suffix: savez must not append one
+            np.savez(tmp, **host)
+            os.replace(tmp, path)
+
+    def load_state(self, ckpt_dir: str) -> bool:
+        """Load this range's dump if one exists; False when absent."""
+        import os
+
+        path = self._ckpt_path(ckpt_dir)
+        if not os.path.exists(path):
+            return False
+        with np.load(path) as z:
+            host = {k: z[k] for k in z.files}
+        if set(host) != set(self.state) or any(
+            host[k].shape != tuple(self.state[k].shape) for k in host
+        ):
+            raise ValueError(
+                f"checkpoint {path} does not match this server's state "
+                "layout (different updater or key range?)"
+            )
+        with self._lock:
+            self.state = {k: self._jnp.asarray(v) for k, v in host.items()}
+        return True
+
+    def start_checkpointing(self, ckpt_dir: str, interval_s: float) -> None:
+        """Background periodic dumps until the server stops (pushes since
+        the last dump are lost on a crash — the bounded-staleness price the
+        reference's recovery design also pays)."""
+
+        def loop() -> None:
+            while not self.server._stop.wait(interval_s):
+                self.save_state(ckpt_dir)
+
+        self._ckpt_thread = threading.Thread(target=loop, daemon=True)
+        self._ckpt_thread.start()
+
+    def stop_checkpointing(self) -> None:
+        """Join the periodic dump thread (the stop event must already be
+        set — serve_forever has returned)."""
+        if self._ckpt_thread is not None:
+            self._ckpt_thread.join(timeout=30)
+            self._ckpt_thread = None
 
     def _resolve_keys(
         self, h: dict[str, Any], arrays: Arrays
@@ -233,12 +299,26 @@ class ServerHandle:
         worker: int,
         cfg: PSConfig,
         range_size: int = 0,
+        resolve_addr=None,  # () -> current address, for server-restart recovery
+        reconnect_timeout_s: float | None = None,
     ):
         import itertools
 
         self.client = RpcClient(address)
         self.rank = rank
         self.worker = worker
+        self._resolve_addr = resolve_addr
+        self._reconnect_timeout_s = (
+            reconnect_timeout_s
+            if reconnect_timeout_s is not None
+            else cfg.fault.reconnect_timeout_s
+        )
+        # a worker's pull and in-flight push threads share this handle;
+        # concurrent failures must rebuild the connection once — the
+        # generation counter lets a late-arriving failing thread see that
+        # another thread already replaced the client and just retry
+        self._reconnect_lock = threading.Lock()
+        self._conn_gen = 0
         self._sent_sigs = _LruSigs()
         self._key_caching = cfg.filter.key_caching
         self._zip = cfg.filter.compressing
@@ -259,7 +339,52 @@ class ServerHandle:
 
     def _keyed_call(self, cmd: str, keys: np.ndarray, arrays: Arrays, **fields):
         """Issue a keyed request, sending the key list only when the server
-        doesn't hold it (key-caching filter, worker side)."""
+        doesn't hold it (key-caching filter, worker side). A lost
+        connection triggers reconnect-and-retry against the (possibly
+        relaunched) server when a resolver was provided."""
+        gen = self._conn_gen
+        try:
+            return self._keyed_call_once(cmd, keys, arrays, **fields)
+        except (ConnectionError, BrokenPipeError, OSError):
+            if self._resolve_addr is None:
+                raise
+            self._reconnect(gen)
+            return self._keyed_call_once(cmd, keys, arrays, **fields)
+
+    def _reconnect(self, failed_gen: int) -> None:
+        """Rebuild the connection to wherever this rank's server now lives
+        (ref: re-resolving the node registry after recovery). The relaunch
+        starts with an empty key cache, so our sent-signature memory is
+        dropped; the need_keys protocol would also recover, at one extra
+        round-trip per cached set.
+
+        failed_gen: the connection generation the caller's failure was
+        observed on — if another thread already replaced that connection,
+        this call must NOT tear the fresh one down, just retry on it."""
+        import time as _time
+
+        with self._reconnect_lock:
+            if self._conn_gen != failed_gen:
+                return  # a concurrent failure already rebuilt the client
+            deadline = _time.monotonic() + self._reconnect_timeout_s
+            self.client.close()
+            last: Exception | None = None
+            while _time.monotonic() < deadline:
+                try:
+                    addr = self._resolve_addr()
+                    self.client = RpcClient(addr, retries=1)
+                    self._sent_sigs = _LruSigs()
+                    self._conn_gen += 1
+                    return
+                except (ConnectionError, OSError) as e:
+                    last = e
+                    _time.sleep(0.3)
+        raise ConnectionError(
+            f"server rank {self.rank} unreachable for "
+            f"{self._reconnect_timeout_s}s: {last}"
+        )
+
+    def _keyed_call_once(self, cmd: str, keys: np.ndarray, arrays: Arrays, **fields):
         sig = _sig(keys)
         send_keys = not (self._key_caching and sig in self._sent_sigs)
         payload = dict(arrays)
@@ -381,10 +506,17 @@ def run_server(
     num_servers: int,
     bind_host: str = "127.0.0.1",
     advertise_host: str = "",
+    ckpt_dir: str = "",
 ) -> None:
     """One server process. ``bind_host="0.0.0.0"`` + a routable
     ``advertise_host`` lets workers on other hosts connect (the default
-    loopback pair only serves the single-host multi-process harness)."""
+    loopback pair only serves the single-host multi-process harness).
+
+    ``ckpt_dir`` enables recovery (ref: each server dumps its own range;
+    resume = reload it): an existing dump for this range is loaded on
+    startup (a relaunched server resumes where its last dump left off),
+    and with fault.server_ckpt_interval_s > 0 the state is re-dumped
+    periodically while serving."""
     from parameter_server_tpu.models.linear import updater_from_config
 
     ranges = KeyRange(0, cfg.data.num_keys).even_divide(num_servers)
@@ -394,11 +526,21 @@ def run_server(
         host=bind_host,
         advertise_host=advertise_host,
     )
+    if ckpt_dir:
+        if srv.load_state(ckpt_dir):
+            print(f"[server {rank}] resumed from {ckpt_dir}", flush=True)
+        if cfg.fault.server_ckpt_interval_s > 0:
+            srv.start_checkpointing(ckpt_dir, cfg.fault.server_ckpt_interval_s)
     ctl = ControlClient(scheduler)
     node_id = ctl.register("server", rank=rank)
+    # set AFTER any resume: workers re-resolving this key must never beat
+    # the state load and pull pre-resume zeros
     ctl.kv_set(f"server_addr/{rank}", addr=srv.address)
     beats = _Beats(scheduler, node_id, cfg.fault.heartbeat_interval_s)
     srv.serve_forever()  # until the scheduler's shutdown
+    if ckpt_dir:
+        srv.stop_checkpointing()  # no periodic writer behind the final dump
+        srv.save_state(ckpt_dir)
     beats.stop()
     ctl.close()
 
@@ -410,9 +552,17 @@ def _connect_servers(
     handles = []
     for s in range(num_servers):
         fields, _ = ctl.kv_get(f"server_addr/{s}", block=True, timeout=60)
+
+        def resolve(s=s) -> str:
+            # re-read the registry: a relaunched server re-publishes its
+            # (new) address under the same rank key
+            f, _ = ctl.kv_get(f"server_addr/{s}", block=True, timeout=10)
+            return f["addr"]
+
         handles.append(
             ServerHandle(
-                fields["addr"], s, worker_rank, cfg, range_size=ranges[s].size
+                fields["addr"], s, worker_rank, cfg,
+                range_size=ranges[s].size, resolve_addr=resolve,
             )
         )
     return handles
@@ -594,6 +744,7 @@ def run_scheduler(
     # on its staleness gate. A plain barrier cannot do this — it would park
     # forever on the dead worker's missing arrival.
     dead_ranks: set[int] = set()
+    server_dead_since: dict[int, float] = {}  # rank -> first seen dead
     t_start = time.monotonic()
 
     def declare_dead(r: int, why: str) -> None:
@@ -616,17 +767,42 @@ def run_scheduler(
             break
         registry = ctl.nodes()
         dead_ids, _alive = ctl.dead_nodes()
+        dead_set = {int(x) for x in dead_ids}
+        alive_server_ranks = {
+            int(n["rank"])
+            for nid2, n in registry.items()
+            if n.get("role") == "server"
+            and "rank" in n
+            and int(nid2) not in dead_set
+        }
         for nid in dead_ids:
             info = registry.get(str(nid), {})
             role = info.get("role")
             if role == "server":
-                # a dead server is unrecoverable (its key range is gone):
-                # fail fast with the cause instead of letting workers hang
-                # on its socket until the launcher timeout
-                raise RuntimeError(
-                    f"shard server rank {info.get('rank')} died "
-                    "(missed heartbeats); aborting the run"
-                )
+                r = int(info.get("rank", -1))
+                grace = cfg.fault.server_restart_grace_s
+                if r in alive_server_ranks:
+                    # a replacement re-registered under this rank (resume
+                    # from its checkpoint); the old corpse can be ignored
+                    server_dead_since.pop(r, None)
+                    continue
+                now = time.monotonic()
+                since = server_dead_since.setdefault(r, now)
+                if grace <= 0 or now - since > grace:
+                    # without checkpoint-backed restart a dead server is
+                    # unrecoverable (its key range is gone): fail fast with
+                    # the cause instead of letting workers hang on its
+                    # socket until the launcher timeout
+                    raise RuntimeError(
+                        f"shard server rank {r} died (missed heartbeats) "
+                        + (
+                            f"and no replacement registered within {grace}s; "
+                            if grace > 0
+                            else "; "
+                        )
+                        + "aborting the run"
+                    )
+                continue
             if role != "worker":
                 continue
             r = int(info.get("rank", -1))
@@ -690,6 +866,8 @@ def launch_local(
     timeout: float = 600.0,
     devices: str = "cpu",
     fault_kill: str = "",
+    fault_restart_after: float = -1.0,
+    ckpt_dir: str = "",
 ) -> dict[str, Any]:
     """Spawn scheduler + servers + workers as real processes on this host
     (ref: script/local.sh — the de-facto integration test harness).
@@ -704,6 +882,10 @@ def launch_local(
     "fault injection = kill a host process in the simulated integration
     test"): SIGKILL the named node 2.0s after it registers with the
     coordinator, exercising dead-node detection + workload requeue.
+
+    ``fault_restart_after >= 0`` respawns the killed node that many seconds
+    after the kill — with ``ckpt_dir`` set (server checkpointing, see
+    run_server) this exercises the checkpoint-backed server recovery path.
     """
     import os
     import socket as socket_mod
@@ -725,7 +907,7 @@ def launch_local(
 
     logdir = tempfile.mkdtemp(prefix="pslaunch_")
 
-    def spawn(role: str, rank: int) -> subprocess.Popen:
+    def spawn(role: str, rank: int, attempt: int = 0) -> subprocess.Popen:
         cmd = [
             sys.executable, "-m", "parameter_server_tpu.cli", "node",
             "--role", role, "--rank", str(rank), "--scheduler", addr,
@@ -734,10 +916,13 @@ def launch_local(
         ]
         if role == "scheduler" and model_out:
             cmd += ["--model_out", model_out]
+        if role == "server" and ckpt_dir:
+            cmd += ["--ckpt_dir", ckpt_dir]
         # child output goes to files, not PIPEs: nobody drains N pipes while
         # training runs, and a chatty child must never block on a full pipe
-        out_f = open(f"{logdir}/{role}-{rank}.out", "w+")
-        err_f = open(f"{logdir}/{role}-{rank}.err", "w+")
+        tag = f"{role}-{rank}" + (f"-r{attempt}" if attempt else "")
+        out_f = open(f"{logdir}/{tag}.out", "w+")
+        err_f = open(f"{logdir}/{tag}.err", "w+")
         p = subprocess.Popen(cmd, stdout=out_f, stderr=err_f, text=True, env=child_env)
         p._ps_logs = (out_f, err_f)  # type: ignore[attr-defined]
         p._ps_tag = f"{role}:{rank}"  # type: ignore[attr-defined]
@@ -752,12 +937,16 @@ def launch_local(
     procs = [spawn("scheduler", 0)]
     procs += [spawn("server", r) for r in range(num_servers)]
     procs += [spawn("worker", r) for r in range(num_workers)]
-    killed_tag = ""
+    victims: list[subprocess.Popen] = []  # processes whose death is the test
+    replacement_box: list[subprocess.Popen] = []  # assassin -> main handoff
+    respawn_lock = threading.Lock()
+    harness_done = threading.Event()
     if fault_kill:
         role_rank, delay_s = fault_kill.split("@")
         kill_role, kill_rank = role_rank.split(":")
         killed_tag = f"{kill_role}:{int(kill_rank)}"
         victim = next(p for p in procs if p._ps_tag == killed_tag)  # type: ignore[attr-defined]
+        victims.append(victim)
 
         def assassin() -> None:
             # wait for the victim to REGISTER first: killing a process that
@@ -777,6 +966,19 @@ def launch_local(
                 ctl.close()
             time.sleep(float(delay_s))
             victim.kill()
+            if fault_restart_after >= 0:
+                time.sleep(fault_restart_after)
+                # checkpoint-backed recovery: the replacement re-registers
+                # under the same rank and reloads its range dump. Spawned
+                # into its own box (NOT procs — the main wait loop is
+                # iterating that) and only while the scheduler is alive:
+                # respawning after the run ended would leave a server
+                # nobody ever shuts down.
+                with respawn_lock:
+                    if not harness_done.is_set() and procs[0].poll() is None:
+                        replacement_box.append(
+                            spawn(kill_role, int(kill_rank), attempt=1)
+                        )
 
         threading.Thread(target=assassin, daemon=True).start()
     deadline = time.monotonic() + timeout
@@ -788,7 +990,23 @@ def launch_local(
             except subprocess.TimeoutExpired:
                 timed_out = True
                 break
+        # the replacement (if any) exits when the scheduler shuts it down;
+        # a replacement spawned too close to run end may have nobody left
+        # to do that — reap it leniently rather than hang or fail the run
+        with respawn_lock:
+            harness_done.set()  # no further respawns
+        for p in replacement_box:
+            if not timed_out:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
     finally:
+        with respawn_lock:
+            harness_done.set()
+        for p in replacement_box:
+            victims.append(p)  # its rc never decides the run's outcome
+            procs.append(p)
         for p in procs:
             if p.poll() is None:
                 p.kill()
@@ -804,7 +1022,7 @@ def launch_local(
         )
         raise RuntimeError(f"multi-process run timed out after {timeout}s:\n{tails}")
     for p, stdout, stderr in outs:
-        if p.returncode != 0 and p._ps_tag != killed_tag:  # type: ignore[attr-defined]
+        if p.returncode != 0 and not any(p is v for v in victims):
             raise RuntimeError(
                 f"node {p._ps_tag} failed rc={p.returncode}:\n{stderr[-2000:]}"  # type: ignore[attr-defined]
             )
@@ -822,6 +1040,7 @@ def run_node(
     model_out: str = "",
     bind_host: str = "127.0.0.1",
     advertise_host: str = "",
+    ckpt_dir: str = "",
 ) -> dict[str, Any] | None:
     """Role dispatch for one spawned process (ref: App::Create + main.cc)."""
     if role == "scheduler":
@@ -834,6 +1053,7 @@ def run_node(
         run_server(
             cfg, scheduler, rank, num_servers,
             bind_host=bind_host, advertise_host=advertise_host,
+            ckpt_dir=ckpt_dir,
         )
         return None
     if role == "worker":

@@ -124,6 +124,12 @@ class FaultConfig:
     heartbeat_timeout_s: float = 10.0  # overdue beats mark a node dead
     straggler_reassign_s: float = 0.0  # age-based workload requeue; 0 off
     startup_grace_s: float = 60.0  # rank never registered by then => dead
+    # server recovery (ref: checkpoint-based hot recovery; SURVEY §5.3/§5.4):
+    server_ckpt_interval_s: float = 0.0  # periodic range dumps; 0 off
+    # dead server: 0 = fail fast (unrecoverable); > 0 = tolerate this many
+    # seconds for a relaunched server to re-register from its checkpoint
+    server_restart_grace_s: float = 0.0
+    reconnect_timeout_s: float = 60.0  # worker retry window per lost server
 
 
 @dataclass
