@@ -74,13 +74,43 @@ class _WorkerStream:
                 return None
             self._current = w
             self._iter = iter(
-                MinibatchReader([w], self.fmt, self.builder, backend=self.backend)
+                MinibatchReader(
+                    [self._reader_path(w)], self.fmt, self.builder,
+                    backend=self.backend,
+                )
             )
+
+    def _reader_path(self, workload: str) -> str:
+        """Map a pool item to the file it names (identity here; the
+        dynamic-pool stream carries an epoch prefix)."""
+        return workload
 
     def _empty(self) -> CSRBatch:
         """Inert batch (all padding) for a drained worker: contributes no
         loss, no gradient."""
         return self.builder.build(np.zeros(0, dtype=np.float32), [], [])
+
+
+class _RemotePool:
+    """WorkloadPool facade over the TCP Coordinator: the wire tier's
+    scheduler assigns shards across SPMD hosts (tier composition)."""
+
+    def __init__(self, ctl):
+        self._ctl = ctl
+
+    def fetch(self, worker: int) -> str | None:
+        return self._ctl.workload_fetch(worker)
+
+    def finish(self, workload: str) -> None:
+        self._ctl.workload_finish(workload)
+
+
+class _EpochStream(_WorkerStream):
+    """_WorkerStream whose pool items are ``"<epoch>:<path>"`` (epochs ride
+    the dynamic pool as distinct workloads)."""
+
+    def _reader_path(self, workload: str) -> str:
+        return workload.split(":", 1)[1]
 
 
 class PodTrainer:
@@ -175,15 +205,76 @@ class PodTrainer:
         report_every: int = 20,
     ) -> dict:
         """Run all epochs over ``files`` sharded across workers."""
+        with self._trace_cm():
+            return self._run_epochs(files, key_mode, report_every)
+
+    def _trace_cm(self):
         import contextlib
 
-        trace_cm = (
+        return (
             jax.profiler.trace(self.profile_dir)
             if self.profile_dir
             else contextlib.nullcontext()
         )
-        with trace_cm:
-            return self._run_epochs(files, key_mode, report_every)
+
+    def train_files_dynamic(
+        self,
+        files: list[str],
+        coordinator: str,
+        key_mode: str = "hash",
+        report_every: int = 20,
+    ) -> dict:
+        """Compose the two multi-process tiers (SURVEY §2.8/§5.8): the TCP
+        tier's Coordinator hands file shards to SPMD hosts DYNAMICALLY
+        (the reference scheduler's WorkloadPool, instead of this module's
+        static per-host split), while the data plane stays XLA collectives
+        over the (data, kv) mesh. A fast host simply fetches more shards;
+        a host that drains early keeps issuing inert steps until the
+        pod-wide example count hits zero (the existing termination
+        contract — dynamic assignment needs no new synchronization).
+
+        Process 0 must be running the Coordinator (or anything hosting
+        its protocol) at ``coordinator``; EVERY process calls this with
+        the same file list. Epochs ride the pool as distinct items."""
+        from parameter_server_tpu.parallel.control import ControlClient
+
+        cfg = self.cfg
+        ctl = ControlClient(coordinator)
+        try:
+            items = [
+                f"{e}:{f}"
+                for e in range(max(1, cfg.solver.epochs))
+                for f in sorted(files)
+            ]
+            if self.runtime.process_index == 0:
+                ctl.workload_init(items)
+                # workload_init is first-wins on the Coordinator: a pool
+                # someone else already initialized (a second dynamic run,
+                # or the wire tier's scheduler) would be silently reused
+                # and this pod would train on nothing — fail loudly
+                st = ctl.workload_stats()
+                total = st["pending"] + st["active"] + st["done"]
+                if total != len(items) or st["done"] or st["active"]:
+                    raise RuntimeError(
+                        f"coordinator at {coordinator} already holds a "
+                        f"workload pool ({st}); train_files_dynamic needs "
+                        "a fresh Coordinator per run"
+                    )
+                ctl.kv_set("pod_pool_ready")
+            else:
+                ctl.kv_get("pod_pool_ready", block=True, timeout=120)
+            pool = _RemotePool(ctl)
+            streams = [
+                _EpochStream(
+                    self.runtime.process_index * self.local_data_shards + w,
+                    pool, cfg.data.format, self._builder(key_mode),
+                )
+                for w in range(self.local_data_shards)
+            ]
+            with self._trace_cm():
+                return self._train_epoch(streams, report_every)
+        finally:
+            ctl.close()
 
     def _run_epochs(self, files, key_mode, report_every) -> dict:
         cfg = self.cfg

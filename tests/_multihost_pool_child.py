@@ -1,0 +1,72 @@
+"""Child process for the tier-composition test: one simulated host of a
+2-process SPMD pod whose file shards are assigned DYNAMICALLY by the TCP
+tier's Coordinator (control plane over the wire, data plane over
+collectives — SURVEY §2.8/§5.8 composed).
+
+Usage: python _multihost_pool_child.py <jax_coord> <nprocs> <pid> <workdir> <pool_coord>
+Prints one JSON line with this host's results.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+
+
+def main() -> None:
+    jax_coord, nprocs, pid, workdir, pool_coord = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5]
+    )
+    from parameter_server_tpu.parallel import runtime
+    from parameter_server_tpu.parallel.trainer import PodTrainer
+    from parameter_server_tpu.utils.config import load_config
+    from parameter_server_tpu.utils.metrics import ProgressReporter
+
+    coord = None
+    if pid == 0:
+        # process 0 hosts the wire tier's Coordinator (the scheduler role)
+        from parameter_server_tpu.parallel.control import Coordinator
+
+        host, port = pool_coord.rsplit(":", 1)
+        coord = Coordinator(host, int(port))
+
+    cfg = load_config(f"{workdir}/app.json")
+    rt = runtime.init(jax_coord, nprocs, pid, cfg=cfg)
+    files = [f"{workdir}/part-{i}.libsvm" for i in range(4)]
+
+    trainer = PodTrainer(
+        cfg, runtime=rt, reporter=ProgressReporter(print_fn=lambda *_: None)
+    )
+    last = trainer.train_files_dynamic(files, pool_coord, report_every=10)
+
+    w = trainer.full_weights()
+    digest = hashlib.blake2b(w.tobytes(), digest_size=12).hexdigest()
+    pool_stats = None
+    if coord is not None:
+        from parameter_server_tpu.parallel.control import ControlClient
+
+        ctl = ControlClient(pool_coord)
+        pool_stats = ctl.workload_stats()
+        ctl.close()
+
+    print(
+        "RESULT "
+        + json.dumps(
+            {
+                "pid": pid,
+                "weights_digest": digest,
+                "examples_seen": trainer.examples_seen,
+                "auc": last.get("auc"),
+                "pool": pool_stats,
+            }
+        ),
+        flush=True,
+    )
+    rt.barrier("pool_child_done")
+    if coord is not None:
+        coord.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -188,3 +188,74 @@ def test_cli_multihost_train(tmp_path):
     # only process 0 dumps the model
     assert (tmp_path / "model-0.txt").exists()
     assert not (tmp_path / "model-1.txt").exists()
+
+
+@pytest.mark.slow
+def test_dynamic_pool_composes_tiers(tmp_path):
+    """Tier composition (SURVEY §2.8/§5.8): 2 SPMD hosts pull file shards
+    DYNAMICALLY from the wire tier's Coordinator while the training data
+    plane runs XLA collectives over the global (data=4, kv=2) mesh. Every
+    shard is processed exactly once pod-wide and both hosts end with
+    bit-identical replicas."""
+    labels, keys, vals, _ = make_sparse_logistic(
+        4000, 900, nnz_per_example=10, noise=0.3, seed=31
+    )
+    for i in range(4):
+        sl = slice(i * 1000, (i + 1) * 1000)
+        write_libsvm(tmp_path / f"part-{i}.libsvm", labels[sl], keys[sl], vals[sl])
+    n_epochs = 3
+    cfg = {
+        "app": "linear_method",
+        "data": {
+            "files": [],
+            "format": "libsvm",
+            "num_keys": 1 << 12,
+            "max_nnz_per_example": 64,
+        },
+        "solver": {"algo": "ftrl", "minibatch": 128, "max_delay": 1,
+                   "epochs": n_epochs},
+        "penalty": {"lambda_l1": 0.05},
+        "parallel": {"data_shards": 4, "kv_shards": 2},
+    }
+    (tmp_path / "app.json").write_text(json.dumps(cfg))
+
+    from parameter_server_tpu.utils.hostenv import force_cpu
+
+    env = force_cpu(dict(os.environ))
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    jax_coord = f"127.0.0.1:{_free_port()}"
+    pool_coord = f"127.0.0.1:{_free_port()}"
+    child = str(REPO / "tests" / "_multihost_pool_child.py")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, child, jax_coord, "2", str(p), str(tmp_path),
+             pool_coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for p in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            stdout, stderr = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"child failed:\n{stderr[-3000:]}"
+        line = next(ln for ln in stdout.splitlines() if ln.startswith("RESULT "))
+        outs.append(json.loads(line[len("RESULT "):]))
+
+    by_pid = {o["pid"]: o for o in outs}
+    # every (epoch, file) item finished exactly once pod-wide
+    assert by_pid[0]["pool"] == {
+        "pending": 0, "active": 0, "done": 4 * n_epochs,
+    }, by_pid
+    # dynamic assignment still feeds the FULL corpus exactly once per epoch
+    total = by_pid[0]["examples_seen"] + by_pid[1]["examples_seen"]
+    assert total == 4000 * n_epochs, by_pid
+    # one logical run: replicas bit-identical across hosts
+    assert by_pid[0]["weights_digest"] == by_pid[1]["weights_digest"]
+    assert by_pid[0]["auc"] and by_pid[0]["auc"] > 0.7, by_pid
