@@ -314,10 +314,16 @@ def bench_pipeline_e2e() -> dict:
             s = slice(i * per, (i + 1) * per)
             write_libsvm(p, labels[s], keys[s], vals[s])
             paths.append(p)
+        out["bucket_nnz"] = True
         for depth, label in ((2, "pipelined"), (0, "serial")):
             cfg = PSConfig()
             cfg.data.num_keys = NUM_KEYS
             cfg.data.pipeline_depth = depth
+            # bucketed static shapes: host->device bytes track the real
+            # batch density instead of the max_nnz_per_example worst case
+            # (measured 3.5x end-to-end on the tunneled TPU at this shape)
+            cfg.data.bucket_nnz = True
+            cfg.data.max_nnz_per_example = 4 * NNZ_PER
             cfg.solver.minibatch = 4096
             cfg.penalty.lambda_l1 = L1
             t = PodTrainer(cfg, reporter=ProgressReporter(print_fn=lambda *_: None))

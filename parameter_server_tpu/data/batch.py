@@ -57,6 +57,7 @@ def training_builder(cfg, key_mode: str = "hash") -> "BatchBuilder":
         key_mode=key_mode,
         freq_filter=freq_filter,
         freq_min_count=cfg.data.freq_min_count,
+        bucket_nnz=cfg.data.bucket_nnz,
     )
 
 
@@ -71,6 +72,57 @@ def eval_builder(cfg, key_mode: str = "hash") -> "BatchBuilder":
         batch_size=cfg.solver.minibatch,
         max_nnz_per_example=cfg.data.max_nnz_per_example,
         key_mode=key_mode,
+        bucket_nnz=cfg.data.bucket_nnz,
+    )
+
+
+# bucketed batches never shrink below this many entries: tiny buckets buy
+# nothing and each distinct shape costs one jit compile
+BUCKET_FLOOR = 2048
+
+
+def _nnz_bucket(n: int, cap: int, floor: int = BUCKET_FLOOR) -> int:
+    """Smallest power-of-two >= n (>= floor), capped at the static max."""
+    b = max(floor, 1 << max(n - 1, 0).bit_length())
+    return min(b, cap)
+
+
+def pad_group(batches: list["CSRBatch"]) -> list["CSRBatch"]:
+    """Bring a group of (possibly bucketed) batches to one static shape —
+    the group max per dimension (buckets are powers of two, so the set of
+    group shapes stays small). Used before stacking D shards."""
+    nnz_t = max(len(b.values) for b in batches)
+    u_t = max(len(b.unique_keys) for b in batches)
+    return [pad_batch(b, nnz_t, u_t) for b in batches]
+
+
+def pad_batch(b: CSRBatch, nnz_cap: int, u_cap: int) -> CSRBatch:
+    """Re-pad a (possibly bucketed) batch to the given capacities — used
+    to bring a group of differently-bucketed batches to one static shape
+    before stacking."""
+    if len(b.values) == nnz_cap and len(b.unique_keys) == u_cap:
+        return b
+    if len(b.values) > nnz_cap or len(b.unique_keys) > u_cap:
+        raise ValueError(
+            f"cannot shrink batch ({len(b.values)}, {len(b.unique_keys)}) "
+            f"to ({nnz_cap}, {u_cap})"
+        )
+
+    def grow(a: np.ndarray, n: int) -> np.ndarray:
+        out = np.zeros(n, dtype=a.dtype)
+        out[: len(a)] = a
+        return out
+
+    return CSRBatch(
+        unique_keys=grow(b.unique_keys, u_cap),
+        local_ids=grow(b.local_ids, nnz_cap),
+        row_ids=grow(b.row_ids, nnz_cap),
+        values=grow(b.values, nnz_cap),
+        labels=b.labels,
+        example_mask=b.example_mask,
+        num_examples=b.num_examples,
+        num_unique=b.num_unique,
+        num_entries=b.num_entries,
     )
 
 
@@ -92,6 +144,7 @@ class BatchBuilder:
         key_mode: str = "hash",
         freq_filter=None,
         freq_min_count: int = 0,
+        bucket_nnz: bool = False,
     ):
         if key_mode not in ("hash", "identity"):
             raise ValueError(f"bad key_mode {key_mode!r}")
@@ -103,6 +156,11 @@ class BatchBuilder:
             self.nnz_capacity + 1, num_keys
         )
         self.key_mode = key_mode
+        # bucketed static shapes (TPU idiom): pad entry/unique arrays to
+        # the next power of two above the REAL count instead of the worst
+        # case — host->device bytes track actual density, and jit compiles
+        # once per bucket (a handful of shapes), not per batch
+        self.bucket_nnz = bucket_nnz
         # streaming admission (ref: parameter/frequency_filter.h — only
         # admit keys seen >= k times; at 10^9-key CTR scale the tail is
         # noise). The sketch counts RAW pre-hash keys as they stream by;
@@ -196,11 +254,17 @@ class BatchBuilder:
                 f"{n_uniq} unique keys > capacity {self.unique_capacity}"
             )
 
+        if self.bucket_nnz:
+            nnz_cap = _nnz_bucket(nnz, self.nnz_capacity)
+            u_cap = min(nnz_cap + 1, self.unique_capacity, self.num_keys)
+        else:
+            nnz_cap = self.nnz_capacity
+            u_cap = self.unique_capacity
         out = CSRBatch(
-            unique_keys=np.zeros(self.unique_capacity, dtype=np.int64),
-            local_ids=np.zeros(self.nnz_capacity, dtype=np.int32),
-            row_ids=np.zeros(self.nnz_capacity, dtype=np.int32),
-            values=np.zeros(self.nnz_capacity, dtype=np.float32),
+            unique_keys=np.zeros(u_cap, dtype=np.int64),
+            local_ids=np.zeros(nnz_cap, dtype=np.int32),
+            row_ids=np.zeros(nnz_cap, dtype=np.int32),
+            values=np.zeros(nnz_cap, dtype=np.float32),
             labels=np.zeros(self.batch_size, dtype=np.float32),
             example_mask=np.zeros(self.batch_size, dtype=bool),
             num_examples=b,

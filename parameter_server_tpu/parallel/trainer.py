@@ -151,6 +151,17 @@ class PodTrainer:
                 f"{got}; update cfg.parallel (or build the runtime with "
                 "runtime.init(..., cfg=cfg)) so both agree"
             )
+        if cfg.data.bucket_nnz and self.runtime.process_count > 1:
+            # bucketed shapes are sized to each host's LOCAL group max;
+            # multi-host SPMD demands identical shapes (and programs) on
+            # every process per step — a pod-wide bucket agreement does
+            # not exist yet, so fail loudly instead of hanging in mixed
+            # collectives
+            raise ValueError(
+                "data.bucket_nnz is single-host only: bucketed batch "
+                "shapes are chosen per host and would violate the "
+                "multi-host same-shape SPMD contract"
+            )
         self.data_shards = self.mesh.shape["data"]
         # this process feeds only its own data rows (multi-host contract)
         self.local_data_shards = self.runtime.local_data_shards
@@ -295,8 +306,12 @@ class PodTrainer:
     @staticmethod
     def _prepare(batches: list[CSRBatch]) -> tuple:
         """Per-step host work: stack D per-worker batches + bookkeeping.
-        Runs on the pipeline's stacker thread (or inline when serial)."""
-        stacked = stack_batches(batches, None)
+        Runs on the pipeline's stacker thread (or inline when serial).
+        Bucketed batches are first re-padded to the group max (buckets are
+        powers of two, so group shapes stay a small compiled set)."""
+        from parameter_server_tpu.data.batch import pad_group
+
+        stacked = stack_batches(pad_group(batches), None)
         n = sum(b.num_examples for b in batches)
         labels = np.concatenate([b.labels[: b.num_examples] for b in batches])
         counts = [b.num_examples for b in batches]
@@ -472,11 +487,17 @@ class PodTrainer:
         ys, ps = [], []
 
         def _flush(group: list[CSRBatch]) -> None:
+            from parameter_server_tpu.data.batch import pad_group
+
             # fill every data shard with real batches (D at a time); only
             # the tail group pads with inert batches
-            batches = group + [
-                _pad_like(builder) for _ in range(self.data_shards - len(group))
-            ]
+            batches = pad_group(
+                group
+                + [
+                    _pad_like(builder)
+                    for _ in range(self.data_shards - len(group))
+                ]
+            )
             probs = np.asarray(
                 self.predict_fn(self.state, stack_batches(batches, self.mesh))
             )

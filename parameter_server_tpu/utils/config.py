@@ -36,6 +36,11 @@ class DataConfig:
     # threadsafe queues): bound of the prefetch queues feeding the SPMD
     # dispatch loop; 0 builds batches serially inline (debugging)
     pipeline_depth: int = 2
+    # bucketed static shapes (TPU idiom): pad batch entry/unique arrays to
+    # the next power of two above the real count instead of the
+    # max_nnz_per_example worst case — host->device bytes track actual
+    # density; jit compiles once per bucket (a handful of shapes)
+    bucket_nnz: bool = False
 
 
 @dataclass

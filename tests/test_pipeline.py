@@ -166,3 +166,80 @@ class TestPodTrainerPipeline:
             assert t.examples_seen == 2 * 2000
         assert aucs[2] > aucs[0] - 0.02, aucs
         assert aucs[2] > 0.75, aucs
+
+
+class TestBucketedBatches:
+    """bucket_nnz: power-of-two static shapes sized to real density (the
+    TPU bucketing idiom) instead of the max_nnz_per_example worst case."""
+
+    def test_builder_buckets_pow2(self):
+        from parameter_server_tpu.data.batch import BUCKET_FLOOR, BatchBuilder
+
+        b = BatchBuilder(
+            num_keys=1 << 16, batch_size=1024, max_nnz_per_example=256,
+            key_mode="identity", bucket_nnz=True,
+        )
+        small = b.build(
+            np.ones(4, dtype=np.float32),
+            [np.arange(3, dtype=np.uint64)] * 4,
+            [np.ones(3, dtype=np.float32)] * 4,
+        )
+        assert len(small.values) == BUCKET_FLOOR  # floor bucket
+        n = 900
+        big = b.build(
+            np.ones(n, dtype=np.float32),
+            [np.arange(9, dtype=np.uint64)] * n,
+            [np.ones(9, dtype=np.float32)] * n,
+        )
+        sz = len(big.values)
+        assert sz >= n * 9 and sz & (sz - 1) == 0
+        assert sz < b.nnz_capacity
+        assert len(big.unique_keys) == sz + 1
+
+    def test_pad_batch_grows_only(self):
+        from parameter_server_tpu.data.batch import BatchBuilder, pad_batch
+
+        b = BatchBuilder(
+            num_keys=1 << 12, batch_size=8, key_mode="identity",
+            bucket_nnz=True,
+        )
+        x = b.build(
+            np.ones(2, dtype=np.float32),
+            [np.array([1, 2], dtype=np.uint64)] * 2,
+            [np.ones(2, dtype=np.float32)] * 2,
+        )
+        big = pad_batch(x, len(x.values) * 2, len(x.unique_keys) * 2)
+        assert len(big.values) == len(x.values) * 2
+        np.testing.assert_array_equal(big.values[: len(x.values)], x.values)
+        assert not big.values[len(x.values):].any()
+        with pytest.raises(ValueError, match="shrink"):
+            pad_batch(big, 4, 4)
+
+    def test_pod_trainer_bucketed_matches_dense(self, svm_files):
+        """Same math, smaller pads: bucketed training must reproduce the
+        dense-padded run's quality on the same stream."""
+        aucs = {}
+        for bucket in (False, True):
+            cfg = _cfg(2)
+            cfg.data.bucket_nnz = bucket
+            t = PodTrainer(cfg, reporter=_quiet())
+            last = t.train_files(svm_files, report_every=5)
+            ev = t.evaluate_files(svm_files[:1])
+            aucs[bucket] = (last["auc"], ev["auc"])
+            assert t.examples_seen == 2 * 2000
+        assert abs(aucs[True][0] - aucs[False][0]) < 0.03, aucs
+        assert abs(aucs[True][1] - aucs[False][1]) < 0.03, aucs
+
+    def test_bucket_nnz_rejected_multi_host(self):
+        """Bucketed shapes are host-local; a multi-host runtime must be
+        refused (the SPMD same-shape contract)."""
+        from parameter_server_tpu.parallel import make_mesh
+        from parameter_server_tpu.parallel.runtime import Runtime
+
+        m = make_mesh(4, 2)
+        rt = Runtime(mesh=m, process_index=0, process_count=2,
+                     data_shards=4, kv_shards=2, local_data_shards=2)
+        cfg = _cfg(2, data_shards=4, kv_shards=2)
+        cfg.data.bucket_nnz = True
+        with pytest.raises(ValueError, match="single-host only"):
+            PodTrainer(cfg, runtime=rt, reporter=_quiet())
