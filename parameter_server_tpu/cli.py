@@ -44,6 +44,18 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     tr.add_argument("--num_processes", type=int, default=1)
     tr.add_argument("--process_id", type=int, default=0)
+    # tier composition: dynamic shard assignment from the wire tier's
+    # Coordinator instead of the static per-host file split
+    tr.add_argument(
+        "--pool_coordinator", default="",
+        help="host:port of a wire-tier Coordinator assigning file shards "
+        "dynamically across pod hosts (PodTrainer.train_files_dynamic)",
+    )
+    tr.add_argument(
+        "--pool_serve", action="store_true",
+        help="process 0 hosts the pool Coordinator at --pool_coordinator "
+        "itself (no external scheduler process needed)",
+    )
 
     ev = sub.add_parser("evaluate", help="evaluate a dumped model")
     ev.add_argument("--app_file", required=True)
@@ -87,6 +99,17 @@ def _build_parser() -> argparse.ArgumentParser:
 def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
     if not cfg.data.files:
         raise SystemExit("config data.files is empty")
+    if args.pool_coordinator and not (
+        cfg.app == "linear_method"
+        and cfg.solver.algo != "darlin"
+        and (args.coordinator or cfg.parallel.data_shards * cfg.parallel.kv_shards > 1)
+    ):
+        # silently ignoring the flag would leave other pod hosts parked on
+        # a coordinator this process never starts or contacts
+        raise SystemExit(
+            "--pool_coordinator requires the pod training path "
+            "(linear_method with a >1x1 parallel mesh or --coordinator)"
+        )
     if cfg.app == "graph_partition":
         from parameter_server_tpu.models.graph_partition import GraphPartition
 
@@ -186,21 +209,45 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
             if not args.ckpt_dir:
                 raise SystemExit("--resume requires --ckpt_dir")
             trainer.load(args.ckpt_dir)
-        out = dict(
-            trainer.train_files(
-                cfg.data.files, report_every=args.report_interval
-            )
-            or {}
-        )
-        if args.ckpt_dir:
-            trainer.save(args.ckpt_dir)
-        if args.model_out and rt.process_index == 0:
-            dump_weights_text(trainer.full_weights().ravel(), args.model_out)
-        if cfg.data.val_files:
-            ev = trainer.evaluate_files(cfg.data.val_files)
-            out.update({f"val_{k}": v for k, v in ev.items()})
-        out["process_index"] = rt.process_index
-        out["mesh"] = {"data": rt.data_shards, "kv": rt.kv_shards}
+        pool_coord = None
+        try:
+            if args.pool_coordinator:
+                if args.pool_serve and rt.process_index == 0:
+                    from parameter_server_tpu.parallel.control import Coordinator
+
+                    host, port = args.pool_coordinator.rsplit(":", 1)
+                    pool_coord = Coordinator(host, int(port))
+                out = dict(
+                    trainer.train_files_dynamic(
+                        cfg.data.files, args.pool_coordinator,
+                        report_every=args.report_interval,
+                    )
+                    or {}
+                )
+            else:
+                out = dict(
+                    trainer.train_files(
+                        cfg.data.files, report_every=args.report_interval
+                    )
+                    or {}
+                )
+            if args.ckpt_dir:
+                trainer.save(args.ckpt_dir)
+            if args.model_out and rt.process_index == 0:
+                dump_weights_text(trainer.full_weights().ravel(), args.model_out)
+            if cfg.data.val_files:
+                ev = trainer.evaluate_files(cfg.data.val_files)
+                out.update({f"val_{k}": v for k, v in ev.items()})
+            out["process_index"] = rt.process_index
+            out["mesh"] = {"data": rt.data_shards, "kv": rt.kv_shards}
+        finally:
+            # reached on errors too: a host that skipped the barrier would
+            # park every other host in sync_global_devices forever, and an
+            # unstopped Coordinator would leak its thread
+            if args.pool_coordinator:
+                rt.barrier("pool_shutdown")  # every host finished fetching
+            if pool_coord is not None:
+                pool_coord.stop()
         return out
 
     from parameter_server_tpu.models.linear import LinearMethod

@@ -157,21 +157,25 @@ class TestModelEvaluation:
         assert res["auc"] == pytest.approx(direct["auc"], abs=1e-6)
 
 
+def run_cli(*argv):
+    """Spawn `python -m parameter_server_tpu.cli ...` on the CPU backend."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "parameter_server_tpu.cli", *argv],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+
+
 class TestCLI:
     def _run(self, *argv):
-        import os
-
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
-        r = subprocess.run(
-            [sys.executable, "-m", "parameter_server_tpu.cli", *argv],
-            capture_output=True,
-            text=True,
-            timeout=300,
-            env=env,
-        )
-        return r
+        return run_cli(*argv)
 
     def test_train_dump_evaluate_cycle(self, svm_files, tmp_path):
         tr, te = svm_files
@@ -264,3 +268,50 @@ class TestCLI:
         r = self._run("train", "--app_file", str(cfg_path))
         assert r.returncode != 0
         assert "data.files is empty" in r.stderr
+
+
+class TestCLIDynamicPool:
+    def test_pool_serve_single_process(self, svm_files, tmp_path):
+        """cli train --pool_coordinator --pool_serve: one process hosts
+        the wire tier's Coordinator and trains its pod through the dynamic
+        workload pool (the user-facing tier composition)."""
+        import socket
+
+        from parameter_server_tpu.utils.config import config_to_dict
+
+        tr, te = svm_files
+        cfg = make_cfg(tr)
+        cfg.data.val_files = [te]
+        cfg.solver.epochs = 2
+        cfg.parallel.data_shards = 4
+        cfg.parallel.kv_shards = 2
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps(config_to_dict(cfg)))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        r = run_cli(
+            "train", "--app_file", str(p),
+            "--pool_coordinator", f"127.0.0.1:{port}", "--pool_serve",
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["mesh"] == {"data": 4, "kv": 2}
+        assert out["val_auc"] > 0.75, out
+
+    def test_pool_coordinator_rejected_off_pod_path(self, svm_files, tmp_path):
+        """The flag must fail loudly on non-pod paths (a silently ignored
+        flag would park other pod hosts on a coordinator that never
+        starts)."""
+        tr, _ = svm_files
+        cfg = make_cfg(tr)  # default 1x1 mesh -> single-process path
+        from parameter_server_tpu.utils.config import config_to_dict
+
+        p = tmp_path / "cfg1.json"
+        p.write_text(json.dumps(config_to_dict(cfg)))
+        r = run_cli(
+            "train", "--app_file", str(p),
+            "--pool_coordinator", "127.0.0.1:1", "--pool_serve",
+        )
+        assert r.returncode != 0
+        assert "pod training path" in r.stderr
