@@ -105,15 +105,8 @@ def bench_device(batches, use_pallas: bool = False) -> tuple[float, list[float]]
     up = Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2,
               use_pallas=use_pallas)
     dev_batches = [batch_to_device(b) for b in batches]
-    # enough steps that one timed run is O(100ms) even on fast chips —
-    # an 11-step run finishes in <1ms on TPU and times only noise
-    cycles = 5
-    runs = []
-    for _ in range(REPEATS):
-        state = up.init(NUM_KEYS, 1)
-        # warmup/compile
-        state, out = train_step(up, state, dev_batches[0])
-        jax.block_until_ready(out["loss_sum"])
+
+    def one_run(state, cycles: int) -> tuple[float, int]:
         t0 = time.perf_counter()
         steps = 0
         for _ in range(cycles):
@@ -121,7 +114,23 @@ def bench_device(batches, use_pallas: bool = False) -> tuple[float, list[float]]
                 state, out = train_step(up, state, b)
                 steps += 1
         jax.block_until_ready(out["loss_sum"])
-        dt = time.perf_counter() - t0
+        return time.perf_counter() - t0, steps
+
+    def warm_state():
+        state = up.init(NUM_KEYS, 1)
+        state, out = train_step(up, state, dev_batches[0])  # warmup/compile
+        jax.block_until_ready(out["loss_sum"])
+        return state
+
+    # size the timed window toward ~0.5s of device work: an 11-step run
+    # finishes in ~1ms on a fast chip and would time only dispatch/sync
+    # noise. Capped: the tunneled accelerator can stall mid-run, and an
+    # unbounded window turns a stall into a driver-visible hang
+    probe_dt, _ = one_run(warm_state(), 1)
+    cycles = min(max(2, int(0.5 / max(probe_dt, 1e-4))), 60)
+    runs = []
+    for _ in range(REPEATS):
+        dt, steps = one_run(warm_state(), cycles)
         runs.append(BATCH * steps / dt)
     return statistics.median(runs), [round(r, 1) for r in runs]
 
@@ -181,7 +190,12 @@ def bench_pallas_ftrl() -> dict:
 
         f = jax.jit(lambda r, gg: up.delta(r, gg))
         jax.block_until_ready(f(rows, g))  # compile
-        iters = 30
+        # adaptive window (~0.5s): a 30-iter loop finishes in ~1ms on a
+        # fast chip and times only dispatch/sync noise
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(rows, g))
+        probe = max(time.perf_counter() - t0, 1e-5)
+        iters = min(max(10, int(0.5 / probe)), 300)  # capped (tunnel stalls)
         t0 = time.perf_counter()
         for _ in range(iters):
             out = f(rows, g)

@@ -86,6 +86,17 @@ def _build_parser() -> argparse.ArgumentParser:
         "periodic dumps per [fault] server_ckpt_interval_s",
     )
 
+    cv = sub.add_parser(
+        "convert",
+        help="offline text -> columnar block cache conversion "
+        "(ref: data/text2proto + SlotReader's parse-once cache)",
+    )
+    cv.add_argument("--app_file", required=True, help="JSON/TOML PSConfig")
+    cv.add_argument(
+        "--cache_dir", default="",
+        help="output cache dir (defaults to the config's data.cache_dir)",
+    )
+
     la = sub.add_parser(
         "launch", help="spawn a local multi-process run (ref: script/local.sh)"
     )
@@ -275,6 +286,35 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
     return last
 
 
+def run_convert(cfg: PSConfig, args: argparse.Namespace) -> dict:
+    """Offline conversion (ref: the text2proto tool + SlotReader's
+    parse-once cache): parse the config's text files once and populate the
+    columnar block cache; later solver runs mmap it instead of re-parsing."""
+    if args.cache_dir:
+        cfg.data.cache_dir = args.cache_dir
+    if not cfg.data.cache_dir:
+        raise SystemExit("convert needs --cache_dir or config data.cache_dir")
+    if not cfg.data.files:
+        raise SystemExit("config data.files is empty")
+    from pathlib import Path
+
+    from parameter_server_tpu.data.blockcache import cached_column_blocks
+
+    cb = cached_column_blocks(cfg)
+    # the entry count comes from the cache sidecar: recomputing it would
+    # page the whole (mmap'd) values array in just to rederive a stored stat
+    meta = json.loads(
+        (Path(cfg.data.cache_dir) / "meta.json").read_text()
+    )
+    return {
+        "cache_dir": cfg.data.cache_dir,
+        "num_examples": cb.num_examples,
+        "n_blocks": cb.n_blocks,
+        "block_size": cb.block_size,
+        "entries": meta["nnz"],
+    }
+
+
 def run_evaluate(cfg: PSConfig, args: argparse.Namespace) -> dict:
     from parameter_server_tpu.models.evaluation import evaluate_model
 
@@ -298,6 +338,8 @@ def main(argv: list[str] | None = None) -> int:
         out = run_train(cfg, args)
     elif args.cmd == "evaluate":
         out = run_evaluate(cfg, args)
+    elif args.cmd == "convert":
+        out = run_convert(cfg, args)
     elif args.cmd == "node":
         from parameter_server_tpu.parallel.multislice import run_node
 

@@ -315,3 +315,29 @@ class TestCLIDynamicPool:
         )
         assert r.returncode != 0
         assert "pod training path" in r.stderr
+
+
+class TestCLIConvert:
+    def test_convert_populates_cache_then_train_reuses(self, svm_files, tmp_path):
+        """cli convert parses once into the columnar block cache (the
+        text2proto analog); a darlin train run then hits the cache."""
+        tr, _ = svm_files
+        from parameter_server_tpu.utils.config import config_to_dict
+
+        cfg = make_cfg(tr)
+        cfg.solver.algo = "darlin"
+        cfg.solver.feature_blocks = 8
+        cfg.solver.block_iters = 3
+        cfg.data.cache_dir = str(tmp_path / "cache")
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps(config_to_dict(cfg)))
+        r = run_cli("convert", "--app_file", str(p))
+        assert r.returncode == 0, r.stderr[-1500:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["num_examples"] == 1600 and out["n_blocks"] == 8
+        assert (tmp_path / "cache" / "meta.json").exists()
+        mtime = (tmp_path / "cache" / "meta.json").stat().st_mtime_ns
+        r2 = run_cli("train", "--app_file", str(p))
+        assert r2.returncode == 0, r2.stderr[-1500:]
+        # the cache was reused, not rebuilt
+        assert (tmp_path / "cache" / "meta.json").stat().st_mtime_ns == mtime
