@@ -94,7 +94,9 @@ def _build_parser() -> argparse.ArgumentParser:
     cv.add_argument("--app_file", required=True, help="JSON/TOML PSConfig")
     cv.add_argument(
         "--cache_dir", default="",
-        help="output cache dir (defaults to the config's data.cache_dir)",
+        help="output cache dir (defaults to the config's data.cache_dir; "
+        "if you override it here, set data.cache_dir to the same path in "
+        "the TRAINING config or the cache will never be read)",
     )
 
     la = sub.add_parser(
@@ -290,7 +292,15 @@ def run_convert(cfg: PSConfig, args: argparse.Namespace) -> dict:
     """Offline conversion (ref: the text2proto tool + SlotReader's
     parse-once cache): parse the config's text files once and populate the
     columnar block cache; later solver runs mmap it instead of re-parsing."""
+    override_note = ""
     if args.cache_dir:
+        if cfg.data.cache_dir and cfg.data.cache_dir != args.cache_dir:
+            # a cache the training config doesn't point at is never read
+            override_note = (
+                "config data.cache_dir is "
+                f"{cfg.data.cache_dir!r}; training will only use this "
+                "cache if you point data.cache_dir at it"
+            )
         cfg.data.cache_dir = args.cache_dir
     if not cfg.data.cache_dir:
         raise SystemExit("convert needs --cache_dir or config data.cache_dir")
@@ -306,13 +316,16 @@ def run_convert(cfg: PSConfig, args: argparse.Namespace) -> dict:
     meta = json.loads(
         (Path(cfg.data.cache_dir) / "meta.json").read_text()
     )
-    return {
+    out = {
         "cache_dir": cfg.data.cache_dir,
         "num_examples": cb.num_examples,
         "n_blocks": cb.n_blocks,
         "block_size": cb.block_size,
         "entries": meta["nnz"],
     }
+    if override_note:
+        out["warning"] = override_note
+    return out
 
 
 def run_evaluate(cfg: PSConfig, args: argparse.Namespace) -> dict:

@@ -96,6 +96,20 @@ def pad_group(batches: list["CSRBatch"]) -> list["CSRBatch"]:
     return [pad_batch(b, nnz_t, u_t) for b in batches]
 
 
+def zero_extend(a: np.ndarray, n: int, axis: int = 0) -> np.ndarray:
+    """Zero-pad ``a`` to length ``n`` along ``axis`` — THE inert-padding
+    primitive (zeros are inert everywhere by the PAD_KEY == slot 0
+    convention); every grow path must come through here so the pad
+    sentinel lives in one place."""
+    if a.shape[axis] == n:
+        return a
+    if a.shape[axis] > n:
+        raise ValueError(f"cannot shrink axis {axis}: {a.shape[axis]} > {n}")
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (0, n - a.shape[axis])
+    return np.pad(a, pad)
+
+
 def pad_batch(b: CSRBatch, nnz_cap: int, u_cap: int) -> CSRBatch:
     """Re-pad a (possibly bucketed) batch to the given capacities — used
     to bring a group of differently-bucketed batches to one static shape
@@ -107,17 +121,11 @@ def pad_batch(b: CSRBatch, nnz_cap: int, u_cap: int) -> CSRBatch:
             f"cannot shrink batch ({len(b.values)}, {len(b.unique_keys)}) "
             f"to ({nnz_cap}, {u_cap})"
         )
-
-    def grow(a: np.ndarray, n: int) -> np.ndarray:
-        out = np.zeros(n, dtype=a.dtype)
-        out[: len(a)] = a
-        return out
-
     return CSRBatch(
-        unique_keys=grow(b.unique_keys, u_cap),
-        local_ids=grow(b.local_ids, nnz_cap),
-        row_ids=grow(b.row_ids, nnz_cap),
-        values=grow(b.values, nnz_cap),
+        unique_keys=zero_extend(b.unique_keys, u_cap),
+        local_ids=zero_extend(b.local_ids, nnz_cap),
+        row_ids=zero_extend(b.row_ids, nnz_cap),
+        values=zero_extend(b.values, nnz_cap),
         labels=b.labels,
         example_mask=b.example_mask,
         num_examples=b.num_examples,

@@ -151,17 +151,13 @@ class PodTrainer:
                 f"{got}; update cfg.parallel (or build the runtime with "
                 "runtime.init(..., cfg=cfg)) so both agree"
             )
-        if cfg.data.bucket_nnz and self.runtime.process_count > 1:
-            # bucketed shapes are sized to each host's LOCAL group max;
-            # multi-host SPMD demands identical shapes (and programs) on
-            # every process per step — a pod-wide bucket agreement does
-            # not exist yet, so fail loudly instead of hanging in mixed
-            # collectives
-            raise ValueError(
-                "data.bucket_nnz is single-host only: bucketed batch "
-                "shapes are chosen per host and would violate the "
-                "multi-host same-shape SPMD contract"
-            )
+        # multi-host bucketing: shapes are sized per host, but SPMD demands
+        # identical shapes (and programs) on every process per step — a
+        # tiny per-step cross-host max-agreement re-pads every host to the
+        # pod max bucket (see _agree_bucket)
+        self._bucket_sync = (
+            cfg.data.bucket_nnz and self.runtime.process_count > 1
+        )
         self.data_shards = self.mesh.shape["data"]
         # this process feeds only its own data rows (multi-host contract)
         self.local_data_shards = self.runtime.local_data_shards
@@ -317,6 +313,34 @@ class PodTrainer:
         counts = [b.num_examples for b in batches]
         return stacked, n, labels, counts
 
+    def _agree_bucket(self, stacked: dict) -> dict:
+        """Pod-wide bucket agreement for bucketed batches: allgather every
+        host's local (nnz, unique) shape, take the max, and zero-pad up to
+        it. One tiny cross-host collective per step — the price of keeping
+        the SPMD same-shape contract while host->device bytes track real
+        density. Buckets are powers of two, so the agreed set of shapes
+        (and compiled programs) stays small pod-wide."""
+        from jax.experimental import multihost_utils
+
+        from parameter_server_tpu.data.batch import zero_extend
+
+        local = np.array(
+            [stacked["values"].shape[1], stacked["unique_keys"].shape[1]],
+            dtype=np.int32,
+        )
+        nnz_t, u_t = (
+            np.asarray(multihost_utils.process_allgather(local))
+            .reshape(-1, 2)
+            .max(axis=0)
+        )
+        return {
+            **stacked,
+            "unique_keys": zero_extend(stacked["unique_keys"], int(u_t), axis=1),
+            "local_ids": zero_extend(stacked["local_ids"], int(nnz_t), axis=1),
+            "row_ids": zero_extend(stacked["row_ids"], int(nnz_t), axis=1),
+            "values": zero_extend(stacked["values"], int(nnz_t), axis=1),
+        }
+
     def _train_epoch(self, streams: list[_WorkerStream], report_every: int) -> dict:
         window: list = []
         n_since = 0
@@ -385,6 +409,8 @@ class PodTrainer:
                 if drained:
                     break
                 stacked_np, n, labels, mask_counts = _next_item()
+                if self._bucket_sync:
+                    stacked_np = self._agree_bucket(stacked_np)
                 stacked = self.runtime.globalize_batch(stacked_np)
                 self.state, out = self.step_fn(self.state, stacked)
                 self.examples_seen += n

@@ -191,7 +191,8 @@ def test_cli_multihost_train(tmp_path):
 
 
 @pytest.mark.slow
-def test_dynamic_pool_composes_tiers(tmp_path):
+@pytest.mark.parametrize("bucket_nnz", [False, True])
+def test_dynamic_pool_composes_tiers(tmp_path, bucket_nnz):
     """Tier composition (SURVEY §2.8/§5.8): 2 SPMD hosts pull file shards
     DYNAMICALLY from the wire tier's Coordinator while the training data
     plane runs XLA collectives over the global (data=4, kv=2) mesh. Every
@@ -217,6 +218,11 @@ def test_dynamic_pool_composes_tiers(tmp_path):
         "penalty": {"lambda_l1": 0.05},
         "parallel": {"data_shards": 4, "kv_shards": 2},
     }
+    # bucket_nnz=True exercises the pod-wide bucket agreement under the
+    # WORST case: dynamic assignment makes per-host shapes diverge and a
+    # drained host emits floor-bucket inert steps while the other still
+    # runs large buckets
+    cfg["data"]["bucket_nnz"] = bucket_nnz
     (tmp_path / "app.json").write_text(json.dumps(cfg))
 
     from parameter_server_tpu.utils.hostenv import force_cpu

@@ -229,17 +229,3 @@ class TestBucketedBatches:
             assert t.examples_seen == 2 * 2000
         assert abs(aucs[True][0] - aucs[False][0]) < 0.03, aucs
         assert abs(aucs[True][1] - aucs[False][1]) < 0.03, aucs
-
-    def test_bucket_nnz_rejected_multi_host(self):
-        """Bucketed shapes are host-local; a multi-host runtime must be
-        refused (the SPMD same-shape contract)."""
-        from parameter_server_tpu.parallel import make_mesh
-        from parameter_server_tpu.parallel.runtime import Runtime
-
-        m = make_mesh(4, 2)
-        rt = Runtime(mesh=m, process_index=0, process_count=2,
-                     data_shards=4, kv_shards=2, local_data_shards=2)
-        cfg = _cfg(2, data_shards=4, kv_shards=2)
-        cfg.data.bucket_nnz = True
-        with pytest.raises(ValueError, match="single-host only"):
-            PodTrainer(cfg, runtime=rt, reporter=_quiet())
