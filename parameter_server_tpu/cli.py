@@ -294,7 +294,7 @@ def run_convert(cfg: PSConfig, args: argparse.Namespace) -> dict:
     columnar block cache; later solver runs mmap it instead of re-parsing."""
     override_note = ""
     if args.cache_dir:
-        if cfg.data.cache_dir and cfg.data.cache_dir != args.cache_dir:
+        if cfg.data.cache_dir != args.cache_dir:
             # a cache the training config doesn't point at is never read
             override_note = (
                 "config data.cache_dir is "

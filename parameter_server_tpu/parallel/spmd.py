@@ -158,6 +158,41 @@ def _local_push_aggregate(
     return {k: state_l[k] + hit * deltas[k] for k in state_l}
 
 
+def _local_push_quantized(
+    updater: Updater,
+    state_l: State,
+    idx: jax.Array,  # (U,) this data shard's unique keys
+    grad: jax.Array,  # (U, vdim)
+    shard_size: int,
+    push_seed: jax.Array,  # scalar int32, varies per step
+) -> State:
+    """Per-worker push with int8-quantized gradients on the wire (the
+    reference's fixing_float filter re-expressed as a quantized
+    COLLECTIVE, cf. EQuARX): each data shard quantizes its gradient
+    symmetrically to int8 with one f32 scale and stochastic (unbiased)
+    rounding; the all_gather then moves 1 byte per value instead of 4 —
+    the payload that dominates cross-slice DCN traffic. Dequantization
+    happens after the gather, so server semantics stay exactly
+    ``_local_push`` (each worker's push is its own updater step)."""
+    key = jax.random.fold_in(
+        jax.random.key(push_seed), lax.axis_index("data")
+    )
+    scale = jnp.max(jnp.abs(grad)) / 127.0 + 1e-30
+    t = grad / scale
+    floor = jnp.floor(t)
+    q = floor + (jax.random.uniform(key, grad.shape) < (t - floor))
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    # the wire: indices + int8 payload + one scale per worker
+    all_idx = lax.all_gather(idx, "data")  # (D, U)
+    all_q = lax.all_gather(q, "data")  # (D, U, vdim) int8
+    all_scale = lax.all_gather(scale, "data")  # (D,)
+    all_grad = all_q.astype(grad.dtype) * all_scale[:, None, None]
+    return _local_push(updater, state_l, all_idx, all_grad, shard_size)
+
+
+PUSH_MODES = ("per_worker", "aggregate", "quantized")
+
+
 def _shard_size(num_keys: int, kv_size: int) -> int:
     if num_keys % kv_size:
         raise ValueError(f"num_keys {num_keys} not divisible by kv axis {kv_size}")
@@ -181,12 +216,15 @@ def make_spmd_train_step(
       "aggregate"  — pre-sum per-key grads across data shards with one psum,
           apply one updater step (see ``_local_push_aggregate``; exactly
           equal for linear SGD, standard sync aggregation otherwise).
+      "quantized"  — per_worker semantics with int8 gradients on the wire
+          (see ``_local_push_quantized``; the fixing_float filter as a
+          quantized collective for DCN-limited pods).
     """
-    if push_mode not in ("per_worker", "aggregate"):
-        raise ValueError(f"unknown push_mode {push_mode!r}")
+    if push_mode not in PUSH_MODES:
+        raise ValueError(f"unknown push_mode {push_mode!r}; known: {PUSH_MODES}")
     shard_size = _shard_size(num_keys, mesh.shape["kv"])
 
-    def local_step(state_l: State, batch: Batch):
+    def local_step(state_l: State, batch: Batch, push_seed: jax.Array):
         b = {k: v[0] for k, v in batch.items()}  # this data shard's batch
         idx = b["unique_keys"]
         w_u = lax.psum(
@@ -203,6 +241,10 @@ def make_spmd_train_step(
         if push_mode == "aggregate":
             new_state = _local_push_aggregate(
                 updater, state_l, idx, g, shard_size
+            )
+        elif push_mode == "quantized":
+            new_state = _local_push_quantized(
+                updater, state_l, idx, g, shard_size, push_seed
             )
         else:
             # Push: every data shard's (keys, grads) reach every kv shard.
@@ -223,21 +265,36 @@ def make_spmd_train_step(
     step = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(state_spec(), batch_spec()),
+        in_specs=(state_spec(), batch_spec(), P()),
         out_specs=(state_spec(), P(), P(), batch_spec()),
         check_vma=False,
     )
 
-    @functools.partial(jax.jit, donate_argnums=0)
-    def jitted(state: State, batch: Batch):
-        new_state, loss_sum, examples, probs = step(state, batch)
+    @functools.partial(jax.jit, donate_argnums=0, static_argnames=())
+    def _jitted(state: State, batch: Batch, push_seed):
+        new_state, loss_sum, examples, probs = step(
+            state, batch, jnp.int32(push_seed)
+        )
         return new_state, {
             "loss_sum": loss_sum,
             "examples": examples,
             "probs": probs,
         }
 
-    return jitted
+    def stepper(state: State, batch: Batch, push_seed=None):
+        if push_seed is None:
+            if push_mode == "quantized":
+                # a silently-defaulted seed would reuse the same PRNG key
+                # every step, correlating the stochastic rounding noise
+                # instead of averaging it out
+                raise ValueError(
+                    "quantized push mode requires a per-step push_seed: "
+                    "call step(state, batch, step_index)"
+                )
+            push_seed = 0
+        return _jitted(state, batch, push_seed)
+
+    return stepper
 
 
 def make_spmd_predict_step(updater: Updater, mesh: Mesh, num_keys: int):

@@ -53,6 +53,11 @@ def linear_step_traffic(
             slice_rows = num_keys // kv_shards
             full = slice_rows * (vdim + 1) * value_bytes  # grads + touched col
             push = int(2 * (data_shards - 1) / data_shards * full)
+        elif push_mode == "quantized":
+            # int8 payload + one f32 scale per worker (fixing_float as a
+            # quantized collective); indices unchanged
+            full = data_shards * (u * (index_bytes + vdim) + value_bytes)
+            push = int((data_shards - 1) / data_shards * full)
         else:
             full = data_shards * u * (index_bytes + vdim * value_bytes)
             push = int((data_shards - 1) / data_shards * full)

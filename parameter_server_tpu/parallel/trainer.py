@@ -158,6 +158,13 @@ class PodTrainer:
         self._bucket_sync = (
             cfg.data.bucket_nnz and self.runtime.process_count > 1
         )
+        if self._bucket_sync and cfg.solver.max_delay > 0:
+            print(
+                "[pod] note: multi-host bucket_nnz agreement caps dispatch "
+                "run-ahead at 1 (see PodTrainer._agree_bucket); max_delay "
+                f"{cfg.solver.max_delay} will not add overlap",
+                flush=True,
+            )
         self.data_shards = self.mesh.shape["data"]
         # this process feeds only its own data rows (multi-host contract)
         self.local_data_shards = self.runtime.local_data_shards
@@ -316,10 +323,16 @@ class PodTrainer:
     def _agree_bucket(self, stacked: dict) -> dict:
         """Pod-wide bucket agreement for bucketed batches: allgather every
         host's local (nnz, unique) shape, take the max, and zero-pad up to
-        it. One tiny cross-host collective per step — the price of keeping
-        the SPMD same-shape contract while host->device bytes track real
-        density. Buckets are powers of two, so the agreed set of shapes
-        (and compiled programs) stays small pod-wide."""
+        it. Buckets are powers of two, so the agreed set of shapes (and
+        compiled programs) stays small pod-wide.
+
+        COST (documented tradeoff): the agreement is a device collective
+        and this thread blocks on its result, which also waits for the
+        previously dispatched step — multi-host bucketed runs therefore
+        cap the SSP/async run-ahead at 1 regardless of max_delay. Worth it
+        when host->device bytes dominate (the bucketing win), not when
+        overlap does; a host-side control-plane reduce (coordinator KV)
+        would lift the cap and is the designed upgrade path."""
         from jax.experimental import multihost_utils
 
         from parameter_server_tpu.data.batch import zero_extend
@@ -412,7 +425,9 @@ class PodTrainer:
                 if self._bucket_sync:
                     stacked_np = self._agree_bucket(stacked_np)
                 stacked = self.runtime.globalize_batch(stacked_np)
-                self.state, out = self.step_fn(self.state, stacked)
+                # push_seed varies per step so quantized-push stochastic
+                # rounding never reuses a key (traced scalar: no recompile)
+                self.state, out = self.step_fn(self.state, stacked, step_idx)
                 self.examples_seen += n
                 n_since += n
                 gate.add(

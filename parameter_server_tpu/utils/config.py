@@ -116,7 +116,10 @@ class ParallelConfig:
     data_shards: int = 1  # 'data' mesh axis: example shards (workers)
     # "per_worker": each worker's push is its own server updater step
     # (reference semantics); "aggregate": pre-sum grads across workers with
-    # one psum and update once (exact for linear SGD; see parallel/spmd.py)
+    # one psum and update once (exact for linear SGD); "quantized":
+    # per_worker semantics with int8 grads on the wire (stochastic
+    # rounding; the fixing_float filter as a quantized collective for
+    # DCN-limited pods). See parallel/spmd.py.
     push_mode: str = "per_worker"
 
 

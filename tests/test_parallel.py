@@ -304,3 +304,64 @@ class TestWorkloadPool:
         assert p.reassign_worker(0) == ["a"]
         stats = p.stats()
         assert stats["pending"] == 1 and stats["active"] == 1
+
+
+def test_quantized_push_tracks_per_worker():
+    """int8-on-the-wire push (fixing_float as a quantized collective):
+    same per-worker server semantics, bounded rounding noise — the
+    trajectory must track the full-precision per_worker run closely and
+    learn equally well."""
+    mesh = make_mesh(4, 2)
+    up = make_updater("ftrl", alpha=0.5, lambda_l1=0.01)
+    finals = {}
+    losses = {}
+    for mode in ("per_worker", "quantized"):
+        step = make_spmd_train_step(up, mesh, NUM_KEYS, push_mode=mode)
+        state = shard_state(up.init(NUM_KEYS, 1), mesh)
+        ls = []
+        batches = make_worker_batches(4, seed=0)
+        stacked = stack_batches(batches, mesh)
+        for i in range(6):
+            state, out = step(state, stacked, i)
+            ls.append(float(out["loss_sum"]))
+        finals[mode] = np.asarray(up.weights(state)).ravel()
+        losses[mode] = ls
+    assert losses["quantized"][-1] < losses["quantized"][0] * 0.8
+    # weights close to the exact run (int8 rounding is the only delta)
+    ref = finals["per_worker"]
+    err = np.abs(finals["quantized"] - ref).max()
+    scale = np.abs(ref).max()
+    assert err < 0.05 * scale + 1e-3, (err, scale)
+
+
+def test_quantized_push_seed_varies_rounding():
+    """Different push seeds must produce different stochastic rounding
+    (a reused key would correlate the rounding noise across steps)."""
+    mesh = make_mesh(2, 2)
+    up = make_updater("sgd", eta=0.5)
+    step = make_spmd_train_step(up, mesh, NUM_KEYS, push_mode="quantized")
+    batches = make_worker_batches(2, seed=3)
+    stacked = stack_batches(batches, mesh)
+    outs = []
+    for seed in (0, 1):
+        state = shard_state(up.init(NUM_KEYS, 1), mesh)
+        state, _ = step(state, stacked, seed)
+        outs.append(np.asarray(state["w"]).ravel())
+    assert not np.array_equal(outs[0], outs[1])
+    # ...but only by rounding noise
+    assert np.abs(outs[0] - outs[1]).max() < 0.05 * np.abs(outs[0]).max() + 1e-3
+
+
+def test_quantized_traffic_estimate():
+    from parameter_server_tpu.parallel.traffic import linear_step_traffic
+
+    per = linear_step_traffic(
+        unique_capacity=1000, vdim=1, data_shards=8, kv_shards=1
+    )
+    qt = linear_step_traffic(
+        unique_capacity=1000, vdim=1, data_shards=8, kv_shards=1,
+        push_mode="quantized",
+    )
+    assert qt.push_bytes < per.push_bytes  # int8 payload beats f32
+    # indices dominate what's left: payload share shrank ~4x
+    assert qt.push_bytes == pytest.approx(per.push_bytes * 5 / 8, rel=0.01)
