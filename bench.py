@@ -351,6 +351,37 @@ def bench_pipeline_e2e() -> dict:
     return out
 
 
+def bench_w2v() -> dict:
+    """word2vec SGNS throughput on the device (BASELINE's second parity
+    config): two vocab-sized embedding tables, fused SGNS step, pairs/sec
+    after compile warmup."""
+    from parameter_server_tpu.models.word2vec import Word2Vec
+    from parameter_server_tpu.utils.metrics import ProgressReporter
+
+    vocab, dim, n_tokens = 1 << 16, 64, 1 << 20
+    rng = np.random.default_rng(11)
+    corpus = rng.integers(0, vocab, n_tokens)
+    w2v = Word2Vec(
+        vocab_size=vocab, dim=dim, eta=0.1, num_negatives=5, window=2,
+        # SSP run-ahead: without it every step pays a full host<->device
+        # round trip on loss retirement (tunnel-latency bound)
+        max_delay=8,
+        reporter=ProgressReporter(print_fn=lambda *_: None),
+    )
+    w2v.train_epoch(corpus[: 1 << 17], batch_size=8192, seed=0)  # warmup
+    pairs = 2 * (2 * n_tokens - 3)  # window=2 skip-gram pair count
+    t0 = time.perf_counter()
+    w2v.train_epoch(corpus, batch_size=8192, seed=1)
+    dt = time.perf_counter() - t0
+    return {
+        "vocab": vocab, "dim": dim, "negatives": 5,
+        "pairs_per_sec": round(pairs / dt, 1),
+        # on the tunneled chip this is floor-bounded by per-step
+        # host->device transfer round trips, not device compute
+        "note": "floor: per-step H2D round trips dominate on a tunnel",
+    }
+
+
 def main() -> None:
     platform = _ensure_reachable_backend()
     batches = _make_batches()
@@ -383,6 +414,7 @@ def main() -> None:
                     "pallas_ftrl": pallas,
                     "spmd_push": bench_spmd_push(),
                     "pipeline_e2e": bench_pipeline_e2e(),
+                    "word2vec": bench_w2v(),
                 },
             }
         )
