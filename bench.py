@@ -27,6 +27,8 @@ Extra fields:
     pipeline_e2e — end-to-end files -> trained AUC throughput through
                    the parallel host input pipeline (parse + build +
                    train), pipelined vs serial ingest.
+    word2vec     — fused-SGNS pairs/sec on the device (BASELINE's second
+                   parity config), SSP-pipelined dispatch.
 """
 
 from __future__ import annotations
@@ -368,10 +370,12 @@ def bench_w2v() -> dict:
         max_delay=8,
         reporter=ProgressReporter(print_fn=lambda *_: None),
     )
-    w2v.train_epoch(corpus[: 1 << 17], batch_size=8192, seed=0)  # warmup
-    pairs = 2 * (2 * n_tokens - 3)  # window=2 skip-gram pair count
+    bs = 8192
+    w2v.train_epoch(corpus[: 1 << 17], batch_size=bs, seed=0)  # warmup
+    total = 2 * (2 * n_tokens - 3)  # window=2 skip-gram pair count
+    pairs = total // bs * bs  # only full batches are dispatched
     t0 = time.perf_counter()
-    w2v.train_epoch(corpus, batch_size=8192, seed=1)
+    w2v.train_epoch(corpus, batch_size=bs, seed=1)
     dt = time.perf_counter() - t0
     return {
         "vocab": vocab, "dim": dim, "negatives": 5,
