@@ -47,3 +47,36 @@ def write_libsvm(path, labels, keys, values) -> None:
         for y, k, v in zip(labels, keys, values):
             feats = " ".join(f"{int(ki)}:{vi:.6g}" for ki, vi in zip(k, v))
             f.write(f"{int(y)} {feats}\n")
+
+
+def make_criteo_ctr(
+    num_examples: int,
+    cat_vocab: int = 64,
+    informative: int = 4,
+    seed: int = 0,
+):
+    """Synthetic Criteo-shaped CTR data: 13 integer columns (noise here)
+    and 26 categorical columns, the first ``informative`` of which carry
+    the label signal. Returns (labels, ints (N, 13), cats (N, 26))."""
+    rng = np.random.default_rng(seed)
+    ints = rng.integers(0, 100, size=(num_examples, 13))
+    cats = rng.integers(0, cat_vocab, size=(num_examples, 26))
+    w = rng.normal(size=(informative, cat_vocab)) * 2.0
+    logits = sum(w[j, cats[:, j]] for j in range(informative))
+    labels = (rng.random(num_examples) < 1.0 / (1.0 + np.exp(-logits))).astype(
+        np.float32
+    )
+    return labels, ints, cats
+
+
+def write_criteo(path, labels, ints, cats) -> None:
+    """Dump rows in Criteo TSV format: label, 13 ints, 26 hex categorical
+    ids (the reference's flagship CTR input format)."""
+    with open(path, "w") as f:
+        for y, ii, cc in zip(labels, ints, cats):
+            cols = (
+                [str(int(y))]
+                + [str(int(v)) for v in ii]
+                + [format(int(v), "x") for v in cc]
+            )
+            f.write("\t".join(cols) + "\n")
