@@ -348,10 +348,28 @@ class ServerHandle:
         except (ConnectionError, BrokenPipeError, OSError):
             if self._resolve_addr is None:
                 raise
-            self._reconnect(gen)
-            return self._keyed_call_once(cmd, keys, arrays, **fields)
+        # retry until the reconnect window closes: one retry is not enough
+        # around a server death — a connect can land in the dying listen
+        # socket's backlog (or reach a not-yet-serving replacement) and
+        # then reset on first use
+        t0 = time.monotonic()
+        deadline = t0 + self._reconnect_timeout_s
+        while True:
+            self._reconnect(gen, deadline)
+            gen = self._conn_gen
+            try:
+                return self._keyed_call_once(cmd, keys, arrays, **fields)
+            except (ConnectionError, BrokenPipeError, OSError) as e:
+                if time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"server rank {self.rank} kept resetting for "
+                        f"{time.monotonic() - t0:.1f}s across reconnects: {e}"
+                    ) from e
+                # backoff: a connect that succeeds into a dying backlog and
+                # resets on first use would otherwise hot-loop at full speed
+                time.sleep(0.3)
 
-    def _reconnect(self, failed_gen: int) -> None:
+    def _reconnect(self, failed_gen: int, deadline: float | None = None) -> None:
         """Rebuild the connection to wherever this rank's server now lives
         (ref: re-resolving the node registry after recovery). The relaunch
         starts with an empty key cache, so our sent-signature memory is
@@ -360,16 +378,17 @@ class ServerHandle:
 
         failed_gen: the connection generation the caller's failure was
         observed on — if another thread already replaced that connection,
-        this call must NOT tear the fresh one down, just retry on it."""
-        import time as _time
-
+        this call must NOT tear the fresh one down, just retry on it.
+        deadline: caller's overall monotonic deadline (the retry loop's);
+        defaults to a fresh reconnect window."""
+        if deadline is None:
+            deadline = time.monotonic() + self._reconnect_timeout_s
         with self._reconnect_lock:
             if self._conn_gen != failed_gen:
                 return  # a concurrent failure already rebuilt the client
-            deadline = _time.monotonic() + self._reconnect_timeout_s
             self.client.close()
             last: Exception | None = None
-            while _time.monotonic() < deadline:
+            while time.monotonic() < deadline:
                 try:
                     addr = self._resolve_addr()
                     self.client = RpcClient(addr, retries=1)
@@ -378,7 +397,7 @@ class ServerHandle:
                     return
                 except (ConnectionError, OSError) as e:
                     last = e
-                    _time.sleep(0.3)
+                    time.sleep(0.3)
         raise ConnectionError(
             f"server rank {self.rank} unreachable for "
             f"{self._reconnect_timeout_s}s: {last}"
