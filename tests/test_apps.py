@@ -245,3 +245,57 @@ class TestWord2VecStreaming:
         within = np.mean([w2v.similarity(0, i) for i in range(1, 5)])
         across = np.mean([w2v.similarity(0, i) for i in range(5, 10)])
         assert within > across + 0.3, (within, across)
+
+
+class TestMatrixFactorizationFiles:
+    """File-driven MF (ref: the reference MF app consumes rating files;
+    BASELINE's MovieLens config): triples stream in bounded blocks."""
+
+    def _write_ratings(self, tmp_path, n=6000, n_u=96, n_i=64, seed=0):
+        us, it, r = make_ratings(
+            n_users=n_u - 1, n_items=n_i - 1, rank=4, n_obs=n, seed=seed
+        )
+        paths = []
+        for i in range(3):
+            p = tmp_path / f"ratings-{i}.txt"
+            sl = slice(i * n // 3, (i + 1) * n // 3)
+            with open(p, "w") as f:
+                for u, v, x in zip(us[sl], it[sl], r[sl]):
+                    f.write(f"{u} {v} {x:.5f}\n")
+            paths.append(str(p))
+        return paths, (us, it, r)
+
+    def test_blocks_roundtrip(self, tmp_path):
+        from parameter_server_tpu.models.matrix_fac import iter_rating_blocks
+
+        paths, (us, it, r) = self._write_ratings(tmp_path, n=600)
+        got_u, got_i, got_r = [], [], []
+        for bu, bi, br in iter_rating_blocks(paths, block_lines=100):
+            assert len(bu) <= 100
+            got_u.append(bu)
+            got_i.append(bi)
+            got_r.append(br)
+        np.testing.assert_array_equal(np.concatenate(got_u), us[:600])
+        np.testing.assert_allclose(np.concatenate(got_r), r[:600], atol=1e-4)
+
+    def test_trains_from_files_single_and_mesh(self, tmp_path):
+        from parameter_server_tpu.parallel import make_mesh
+
+        paths, _ = self._write_ratings(tmp_path)
+        for mesh in (None, make_mesh(2, 4)):
+            mf = MatrixFactorization(95, 63, rank=8, eta=0.1, l2=0.002,
+                                     reporter=quiet(), mesh=mesh)
+            first = mf.train_files(paths, batch_size=500, block_lines=1500,
+                                   seed=0)
+            last = first
+            for ep in range(1, 10):
+                last = mf.train_files(paths, batch_size=500,
+                                      block_lines=1500, seed=ep)
+            assert last < first * 0.7, (mesh, first, last)
+
+    def test_unparseable_files_raise(self, tmp_path):
+        p = tmp_path / "ratings.csv"
+        p.write_text("1,2,3.5\n4,5,2.0\n")  # comma-separated: wrong format
+        mf = MatrixFactorization(95, 63, rank=4, reporter=quiet())
+        with pytest.raises(ValueError, match="no rating triples"):
+            mf.train_files([str(p)])
