@@ -109,7 +109,17 @@ def _build_parser() -> argparse.ArgumentParser:
     return p
 
 
+_KNOWN_APPS = (
+    "linear_method", "graph_partition", "sketch", "matrix_fac", "word2vec"
+)
+
+
 def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
+    if cfg.app not in _KNOWN_APPS:
+        # an unknown app would silently fall through to linear_method
+        raise SystemExit(
+            f"unknown app {cfg.app!r}; known: {sorted(_KNOWN_APPS)}"
+        )
     if not cfg.data.files:
         raise SystemExit("config data.files is empty")
     if args.pool_coordinator and not (
@@ -140,6 +150,10 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
         if args.model_out:
             out["dumped"] = app.dump_heavy_hitters(args.model_out)
         return out
+    if cfg.app == "matrix_fac":
+        return _run_train_mf(cfg, args)
+    if cfg.app == "word2vec":
+        return _run_train_w2v(cfg, args)
     if cfg.solver.algo == "darlin":
         from parameter_server_tpu.data.batch import BatchBuilder
         from parameter_server_tpu.data.reader import MinibatchReader
@@ -286,6 +300,85 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
         )
         last = {**last, **{f"val_{k}": v for k, v in ev.items()}}
     return last
+
+
+def _mesh_from_cfg(cfg: PSConfig):
+    if cfg.parallel.data_shards * cfg.parallel.kv_shards > 1:
+        from parameter_server_tpu.parallel import make_mesh
+
+        return make_mesh(cfg.parallel.data_shards, cfg.parallel.kv_shards)
+    return None
+
+
+def _run_train_mf(cfg: PSConfig, args: argparse.Namespace) -> dict:
+    """matrix_fac app dispatch (ref: App::Create on the MF config)."""
+    import numpy as np
+
+    from parameter_server_tpu.models.matrix_fac import MatrixFactorization
+
+    m = cfg.mf
+    app = MatrixFactorization(
+        m.num_users, m.num_items, rank=m.rank, eta=m.eta, l2=m.l2,
+        algo=m.algo, seed=cfg.seed, mesh=_mesh_from_cfg(cfg),
+        push_mode=cfg.parallel.push_mode,
+        max_delay=max(cfg.solver.max_delay, 0),
+    )
+    rmse = app.train_files(
+        cfg.data.files, batch_size=m.batch_size,
+        epochs=max(1, cfg.solver.epochs), block_lines=m.block_lines,
+        seed=cfg.seed,
+    )
+    out: dict = {"train_rmse": rmse, "rank": m.rank}
+    if cfg.data.val_files:
+        from parameter_server_tpu.models.matrix_fac import iter_rating_blocks
+
+        sse, n = 0.0, 0
+        for us, it, rt in iter_rating_blocks(cfg.data.val_files, m.block_lines):
+            p = app.predict(us, it)
+            sse += float(((p - rt) ** 2).sum())
+            n += len(rt)
+        if n == 0:
+            # mirror train_files: a perfect 0.0 RMSE over zero parsed
+            # triples must never be reported
+            raise SystemExit(
+                f"no rating triples parsed from val_files "
+                f"{cfg.data.val_files}: expected 'user item rating' lines"
+            )
+        out["val_rmse"] = float(np.sqrt(sse / n))
+        out["val_examples"] = n
+    if args.model_out:
+        U = np.asarray(app.user_up.weights(app.user_state))
+        V = np.asarray(app.item_up.weights(app.item_state))
+        np.savez(args.model_out, user_factors=U, item_factors=V)
+        out["model_out"] = args.model_out
+    return out
+
+
+def _run_train_w2v(cfg: PSConfig, args: argparse.Namespace) -> dict:
+    """word2vec app dispatch (ref: App::Create on the SGNS config)."""
+    import numpy as np
+
+    from parameter_server_tpu.models.word2vec import Word2Vec
+
+    w = cfg.w2v
+    app = Word2Vec(
+        vocab_size=w.vocab_size, dim=w.dim, eta=w.eta,
+        num_negatives=w.negatives, window=w.window, seed=cfg.seed,
+        mesh=_mesh_from_cfg(cfg), max_delay=max(cfg.solver.max_delay, 0),
+        push_mode=cfg.parallel.push_mode,
+    )
+    # one call: train_files runs its epoch loop internally and pays the
+    # vocab-counting pass ONCE, not once per epoch
+    mean = app.train_files(
+        cfg.data.files, batch_size=w.batch_size,
+        epochs=max(1, cfg.solver.epochs),
+        block_tokens=w.block_tokens, seed=cfg.seed,
+    )
+    out: dict = {"mean_loss": mean, "vocab_size": w.vocab_size, "dim": w.dim}
+    if args.model_out:
+        np.save(args.model_out, app.embeddings())
+        out["model_out"] = args.model_out
+    return out
 
 
 def run_convert(cfg: PSConfig, args: argparse.Namespace) -> dict:

@@ -232,9 +232,13 @@ def iter_rating_blocks(
                 parts = line.split()
                 if len(parts) < 3:
                     continue
-                us.append(int(parts[0]))
-                it.append(int(parts[1]))
-                rt.append(float(parts[2]))
+                try:
+                    u, v, x = int(parts[0]), int(parts[1]), float(parts[2])
+                except ValueError:
+                    continue  # header / malformed line: skip, don't crash
+                us.append(u)
+                it.append(v)
+                rt.append(x)
                 if len(us) >= block_lines:
                     yield (
                         np.asarray(us, dtype=np.int64),
@@ -295,6 +299,17 @@ class MatrixFactorization:
         self.mesh = mesh
         self.max_delay = max_delay  # SSP dispatch bound (ref: wait_time)
         if mesh is not None:
+            kv = mesh.shape["kv"]
+            for what, rows in (("num_users", num_users), ("num_items", num_items)):
+                if (rows + 1) % kv:
+                    # surface the hidden +1 pad row — a round user-chosen
+                    # size always fails the raw _shard_size check with a
+                    # message naming neither knob
+                    raise ValueError(
+                        f"{what}+1 = {rows + 1} (the table has a pad row 0) "
+                        f"must be divisible by kv_shards={kv}; pick "
+                        f"{what} = k*{kv} - 1"
+                    )
             from parameter_server_tpu.parallel.spmd import shard_state
 
             self._spmd_step = make_mf_spmd_train_step(

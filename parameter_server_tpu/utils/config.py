@@ -89,6 +89,35 @@ class GraphConfig:
 
 
 @dataclass
+class MFConfig:
+    """matrix_fac app settings (ref: the MF app's config; BASELINE's
+    MovieLens parity config). data.files = 'user item rating' text."""
+
+    num_users: int = 1000
+    num_items: int = 1000
+    rank: int = 64
+    eta: float = 0.05
+    l2: float = 0.01
+    algo: str = "adagrad"  # adagrad | sgd
+    batch_size: int = 4096
+    block_lines: int = 1 << 20  # streaming shuffle-block size
+
+
+@dataclass
+class W2VConfig:
+    """word2vec app settings (ref: BASELINE's SGNS parity config).
+    data.files = whitespace-separated token-id text (or .npy)."""
+
+    vocab_size: int = 1 << 16
+    dim: int = 64
+    window: int = 2
+    negatives: int = 5
+    eta: float = 0.3
+    batch_size: int = 8192
+    block_tokens: int = 1 << 20
+
+
+@dataclass
 class SketchConfig:
     """sketch app settings (ref: the sketch App — distributed count-min)."""
 
@@ -152,6 +181,8 @@ class PSConfig:
     filter: FilterConfig = field(default_factory=FilterConfig)
     graph: GraphConfig = field(default_factory=GraphConfig)
     sketch: SketchConfig = field(default_factory=SketchConfig)
+    mf: MFConfig = field(default_factory=MFConfig)
+    w2v: W2VConfig = field(default_factory=W2VConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
     model_output: str = ""
@@ -190,6 +221,8 @@ _NESTED = {
     "filter": FilterConfig,
     "graph": GraphConfig,
     "sketch": SketchConfig,
+    "mf": MFConfig,
+    "w2v": W2VConfig,
     "parallel": ParallelConfig,
     "fault": FaultConfig,
 }

@@ -341,3 +341,85 @@ class TestCLIConvert:
         assert r2.returncode == 0, r2.stderr[-1500:]
         # the cache was reused, not rebuilt
         assert (tmp_path / "cache" / "meta.json").stat().st_mtime_ns == mtime
+
+
+class TestCLIAppFactory:
+    """cfg.app dispatch for the embedding apps (ref: App::Create covers
+    EVERY app from config, not just linear_method)."""
+
+    def test_unknown_app_rejected(self, svm_files, tmp_path):
+        tr, _ = svm_files
+        from parameter_server_tpu.utils.config import config_to_dict
+
+        cfg = make_cfg(tr)
+        cfg.app = "lda"
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps(config_to_dict(cfg)))
+        r = run_cli("train", "--app_file", str(p))
+        assert r.returncode != 0 and "unknown app" in r.stderr
+
+    def test_matrix_fac_app(self, tmp_path):
+        rng = np.random.default_rng(0)
+        n, n_u, n_i = 4000, 96, 64
+        U = rng.normal(size=(n_u, 4)) / 2
+        V = rng.normal(size=(n_i, 4)) / 2
+        us = rng.integers(0, n_u - 1, n)
+        it = rng.integers(0, n_i - 1, n)
+        r = (np.sum(U[us] * V[it], 1)).astype(np.float32)
+        tr_p, val_p = tmp_path / "tr.txt", tmp_path / "val.txt"
+        for p, sl in ((tr_p, slice(0, 3500)), (val_p, slice(3500, None))):
+            with open(p, "w") as f:
+                for u, v, x in zip(us[sl], it[sl], r[sl]):
+                    f.write(f"{u} {v} {x:.5f}\n")
+        cfg = {
+            "app": "matrix_fac",
+            "data": {"files": [str(tr_p)], "val_files": [str(val_p)]},
+            "mf": {"num_users": n_u - 1, "num_items": n_i - 1, "rank": 8,
+                   "eta": 0.1, "l2": 0.002, "batch_size": 500},
+            "solver": {"epochs": 12},
+            "parallel": {"data_shards": 2, "kv_shards": 4},
+        }
+        p = tmp_path / "mf.json"
+        p.write_text(json.dumps(cfg))
+        r = run_cli("train", "--app_file", str(p),
+                    "--model_out", str(tmp_path / "factors.npz"))
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["val_rmse"] < 0.45, out
+        z = np.load(tmp_path / "factors.npz")
+        assert z["user_factors"].shape == (n_u, 8)
+
+    def test_word2vec_app(self, tmp_path):
+        rng = np.random.default_rng(0)
+        chunks = []
+        for _ in range(500):
+            topic = rng.integers(0, 2)
+            chunks.append(rng.integers(0, 5, 8) + 5 * topic)
+        corpus = np.concatenate(chunks)
+        cp = tmp_path / "corpus.txt"
+        cp.write_text(" ".join(map(str, corpus)))
+        cfg = {
+            "app": "word2vec",
+            "data": {"files": [str(cp)]},
+            "w2v": {"vocab_size": 16, "dim": 16, "window": 2,
+                    "negatives": 4, "eta": 0.5, "batch_size": 1024,
+                    "block_tokens": 2048},
+            "solver": {"epochs": 6, "max_delay": 1},
+            "parallel": {"data_shards": 2, "kv_shards": 2},
+        }
+        p = tmp_path / "w2v.json"
+        p.write_text(json.dumps(cfg))
+        emb_out = tmp_path / "emb.npy"
+        r = run_cli("train", "--app_file", str(p), "--model_out", str(emb_out))
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert np.isfinite(out["mean_loss"])
+        E = np.load(emb_out)
+        assert E.shape == (16, 16)
+        # topic structure visible in the dumped embeddings
+        def sim(a, b):
+            den = np.linalg.norm(E[a]) * np.linalg.norm(E[b])
+            return E[a] @ E[b] / den
+        within = np.mean([sim(0, i) for i in range(1, 5)])
+        across = np.mean([sim(0, i) for i in range(5, 10)])
+        assert within > across, (within, across)
