@@ -40,6 +40,13 @@ class PrefetchPipeline:
         the per-step host work (stacking, label bookkeeping) moved off the
         dispatch loop.
     depth: bound of every internal queue (per-stream and output).
+    group_size / assemble: multistep grouping ON the stacker thread —
+        every ``group_size`` prepared items are combined by
+        ``assemble(items) -> group_item`` before emission, so the K-way
+        group stacking (one device call's worth of microsteps) never runs
+        on the dispatch loop. A partial final group is padded with
+        prepared inert items (empties only ever trail real batches —
+        the termination contract's invariant).
     """
 
     def __init__(
@@ -47,11 +54,17 @@ class PrefetchPipeline:
         streams: Sequence[Any],
         prepare: Callable[[list], Any],
         depth: int = 2,
+        group_size: int = 1,
+        assemble: Callable[[list], Any] | None = None,
     ):
         if depth < 1:
             raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        if group_size > 1 and assemble is None:
+            raise ValueError("group_size > 1 requires an assemble callable")
         self.streams = list(streams)
         self.prepare = prepare
+        self.group_size = group_size
+        self.assemble = assemble
         self._qs = [queue.Queue(maxsize=depth) for _ in self.streams]
         self._out: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
@@ -101,6 +114,7 @@ class PrefetchPipeline:
 
     def _stack_loop(self) -> None:
         done = [False] * len(self.streams)
+        pending: list = []  # partially-filled multistep group
         try:
             while not self._stop.is_set():
                 batches = []
@@ -116,8 +130,21 @@ class PrefetchPipeline:
                         batches.append(item)
                 if all(done):
                     break
-                if not self._put(self._out, self.prepare(batches)):
-                    return
+                prepared = self.prepare(batches)
+                if self.group_size == 1:
+                    if not self._put(self._out, prepared):
+                        return
+                    continue
+                pending.append(prepared)
+                if len(pending) == self.group_size:
+                    if not self._put(self._out, self.assemble(pending)):
+                        return
+                    pending = []
+            if pending and not self._stop.is_set():
+                # pad the final partial group with inert prepared items
+                empty = self.prepare([s._empty() for s in self.streams])
+                pending += [empty] * (self.group_size - len(pending))
+                self._put(self._out, self.assemble(pending))
         except BaseException as e:
             self._errs.append(e)
         finally:

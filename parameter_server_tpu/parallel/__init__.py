@@ -16,9 +16,11 @@ from parameter_server_tpu.parallel.mesh import make_mesh  # noqa: F401
 from parameter_server_tpu.parallel.runtime import Runtime  # noqa: F401
 from parameter_server_tpu.parallel.spmd import (  # noqa: F401
     make_spmd_predict_step,
+    make_spmd_train_multistep,
     make_spmd_train_step,
     shard_state,
     stack_batches,
+    stack_step_groups,
 )
 from parameter_server_tpu.parallel.ssp import SSPClock  # noqa: F401
 from parameter_server_tpu.parallel.workload import WorkloadPool  # noqa: F401

@@ -199,6 +199,76 @@ def _shard_size(num_keys: int, kv_size: int) -> int:
     return num_keys // kv_size
 
 
+def _wrap_stepper(step, push_mode: str):
+    """Shared jit + push_seed contract for the single- and multi-step
+    makers (one home for the quantized-seed guard): ``step`` is the
+    shard_map'd program (state, batch, seed) -> (state, loss, ex, probs)."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def _jitted(state: State, batch: Batch, push_seed):
+        new_state, loss, ex, probs = step(state, batch, jnp.int32(push_seed))
+        return new_state, {"loss_sum": loss, "examples": ex, "probs": probs}
+
+    def stepper(state: State, batch: Batch, push_seed=None):
+        if push_seed is None:
+            if push_mode == "quantized":
+                # a silently-defaulted seed would reuse the same PRNG key
+                # every step, correlating the stochastic rounding noise
+                # instead of averaging it out
+                raise ValueError(
+                    "quantized push mode requires a per-step push_seed: "
+                    "call step(state, batch, step_index)"
+                )
+            push_seed = 0
+        return _jitted(state, batch, push_seed)
+
+    return stepper
+
+
+def _microstep(
+    updater: Updater,
+    state_l: State,
+    b: Batch,  # one data shard's un-stacked batch fields
+    shard_size: int,
+    push_mode: str,
+    push_seed: jax.Array,
+):
+    """One parameter-server step on this device: pull -> CSR grad -> push.
+    Shared verbatim by the single-step and scanned multi-step programs so
+    the wire semantics cannot diverge between them."""
+    idx = b["unique_keys"]
+    w_u = lax.psum(
+        _local_pull(updater, state_l, idx, shard_size), "kv"
+    )  # Pull: slice + merge (ref kv_vector match)
+    logits = csr_logits(
+        w_u, b["values"], b["local_ids"], b["row_ids"],
+        num_rows=b["labels"].shape[0],
+    )
+    loss, err = logistic_loss(logits, b["labels"], b["example_mask"])
+    g = csr_grad(
+        err, b["values"], b["local_ids"], b["row_ids"], num_unique=idx.shape[0]
+    )
+    if push_mode == "aggregate":
+        new_state = _local_push_aggregate(updater, state_l, idx, g, shard_size)
+    elif push_mode == "quantized":
+        new_state = _local_push_quantized(
+            updater, state_l, idx, g, shard_size, push_seed
+        )
+    else:
+        # Push: every data shard's (keys, grads) reach every kv shard.
+        all_idx = lax.all_gather(idx, "data")  # (D, U)
+        all_grad = lax.all_gather(g, "data")  # (D, U, vdim)
+        new_state = _local_push(updater, state_l, all_idx, all_grad, shard_size)
+    loss_sum = lax.psum(loss, "data")
+    # pod-wide real-example count: the host-side termination signal
+    # (a drained host keeps feeding empty batches; every host stops
+    # deterministically after retiring a step with examples == 0 —
+    # this rides async dispatch instead of a blocking host barrier)
+    examples = lax.psum(jnp.sum(b["example_mask"]), "data")
+    probs = jax.nn.sigmoid(logits)
+    return new_state, loss_sum, examples, probs
+
+
 def make_spmd_train_step(
     updater: Updater, mesh: Mesh, num_keys: int, push_mode: str = "per_worker"
 ):
@@ -226,41 +296,10 @@ def make_spmd_train_step(
 
     def local_step(state_l: State, batch: Batch, push_seed: jax.Array):
         b = {k: v[0] for k, v in batch.items()}  # this data shard's batch
-        idx = b["unique_keys"]
-        w_u = lax.psum(
-            _local_pull(updater, state_l, idx, shard_size), "kv"
-        )  # Pull: slice + merge (ref kv_vector match)
-        logits = csr_logits(
-            w_u, b["values"], b["local_ids"], b["row_ids"],
-            num_rows=b["labels"].shape[0],
+        new_state, loss_sum, examples, probs = _microstep(
+            updater, state_l, b, shard_size, push_mode, push_seed
         )
-        loss, err = logistic_loss(logits, b["labels"], b["example_mask"])
-        g = csr_grad(
-            err, b["values"], b["local_ids"], b["row_ids"], num_unique=idx.shape[0]
-        )
-        if push_mode == "aggregate":
-            new_state = _local_push_aggregate(
-                updater, state_l, idx, g, shard_size
-            )
-        elif push_mode == "quantized":
-            new_state = _local_push_quantized(
-                updater, state_l, idx, g, shard_size, push_seed
-            )
-        else:
-            # Push: every data shard's (keys, grads) reach every kv shard.
-            all_idx = lax.all_gather(idx, "data")  # (D, U)
-            all_grad = lax.all_gather(g, "data")  # (D, U, vdim)
-            new_state = _local_push(
-                updater, state_l, all_idx, all_grad, shard_size
-            )
-        loss_sum = lax.psum(loss, "data")
-        # pod-wide real-example count: the host-side termination signal
-        # (a drained host keeps feeding empty batches; every host stops
-        # deterministically after retiring a step with examples == 0 —
-        # this rides async dispatch instead of a blocking host barrier)
-        examples = lax.psum(jnp.sum(b["example_mask"]), "data")
-        probs = jax.nn.sigmoid(logits)[None, :]  # (1, B) -> gathers to (D, B)
-        return new_state, loss_sum, examples, probs
+        return new_state, loss_sum, examples, probs[None, :]  # -> (D, B)
 
     step = shard_map(
         local_step,
@@ -269,32 +308,87 @@ def make_spmd_train_step(
         out_specs=(state_spec(), P(), P(), batch_spec()),
         check_vma=False,
     )
+    return _wrap_stepper(step, push_mode)
 
-    @functools.partial(jax.jit, donate_argnums=0, static_argnames=())
-    def _jitted(state: State, batch: Batch, push_seed):
-        new_state, loss_sum, examples, probs = step(
-            state, batch, jnp.int32(push_seed)
+
+def make_spmd_train_multistep(
+    updater: Updater, mesh: Mesh, num_keys: int, push_mode: str = "per_worker"
+):
+    """K parameter-server steps per device call: ``lax.scan`` over a
+    leading microstep axis inside ONE jitted shard_map program.
+
+    Why: on a tunneled or dispatch-bound host, per-step host->device
+    round trips (transfer + dispatch + retirement sync) put a hard floor
+    under examples/sec no matter how fast the chip is. Scanning K
+    microsteps amortizes that floor K-fold: one transfer of K stacked
+    batches in, one device program, one retirement out. The TPU idiom for
+    the reference's bounded-delay pipelining of many small Push/Pull
+    tasks (SURVEY §2.9 SSP): the steps stay SEQUENTIAL — microstep i+1
+    pulls weights that include microstep i's push, exactly as if
+    dispatched one by one — so the math is the single-step trajectory,
+    not a K-times-larger batch.
+
+    batch fields are stacked (D, K, ...): data shard leading (sharded),
+    microstep second (scanned). step(state, batch, push_seed) ->
+    (state, out) with out keys:
+      "loss_sum" — (K,) per-microstep pod-wide loss sums
+      "examples" — (K,) per-microstep pod-wide real-example counts (the
+          termination contract checks the LAST entry: empties only ever
+          trail real batches within a group)
+      "probs"    — (D, K, B) per-shard, per-microstep probabilities
+    """
+    if push_mode not in PUSH_MODES:
+        raise ValueError(f"unknown push_mode {push_mode!r}; known: {PUSH_MODES}")
+    shard_size = _shard_size(num_keys, mesh.shape["kv"])
+
+    def local_step(state_l: State, batch: Batch, push_seed: jax.Array):
+        b = {k: v[0] for k, v in batch.items()}  # this shard's (K, ...) group
+        n_micro = b["labels"].shape[0]
+
+        def body(st: State, micro):
+            mb, i = micro
+            # quantized mode: a distinct PRNG key per microstep (the
+            # same per-step-seed contract as single-step dispatch)
+            new_st, loss, ex, probs = _microstep(
+                updater, st, mb, shard_size, push_mode, push_seed + i
+            )
+            return new_st, (loss, ex, probs)
+
+        new_state, (losses, exs, probs) = lax.scan(
+            body, state_l, (b, jnp.arange(n_micro, dtype=jnp.int32))
         )
-        return new_state, {
-            "loss_sum": loss_sum,
-            "examples": examples,
-            "probs": probs,
-        }
+        return new_state, losses, exs, probs[None]  # -> (D, K, B)
 
-    def stepper(state: State, batch: Batch, push_seed=None):
-        if push_seed is None:
-            if push_mode == "quantized":
-                # a silently-defaulted seed would reuse the same PRNG key
-                # every step, correlating the stochastic rounding noise
-                # instead of averaging it out
-                raise ValueError(
-                    "quantized push mode requires a per-step push_seed: "
-                    "call step(state, batch, step_index)"
-                )
-            push_seed = 0
-        return _jitted(state, batch, push_seed)
+    step = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec(), batch_spec(), P()),
+        out_specs=(state_spec(), P(), P(), batch_spec()),
+        check_vma=False,
+    )
+    return _wrap_stepper(step, push_mode)
 
-    return stepper
+
+def stack_step_groups(stacked_items: list[Batch]) -> Batch:
+    """Stack K per-step stacked dicts — each (D, ...) — into one (D, K, ...)
+    multistep group. Bucketed items are first zero-padded to the group max
+    on their variable (trailing) axis; buckets are powers of two, so the
+    set of group shapes (and compiled programs) stays small."""
+    import numpy as np
+
+    from parameter_server_tpu.data.batch import zero_extend
+
+    targets = {
+        f: max(d[f].shape[-1] for d in stacked_items)
+        for f in stacked_items[0]
+    }
+    return {
+        f: np.stack(
+            [zero_extend(d[f], targets[f], axis=-1) for d in stacked_items],
+            axis=1,
+        )
+        for f in stacked_items[0]
+    }
 
 
 def make_spmd_predict_step(updater: Updater, mesh: Mesh, num_keys: int):

@@ -33,8 +33,10 @@ from parameter_server_tpu.parallel.mesh import make_mesh
 from parameter_server_tpu.parallel.runtime import Runtime
 from parameter_server_tpu.parallel.spmd import (
     make_spmd_predict_step,
+    make_spmd_train_multistep,
     make_spmd_train_step,
     stack_batches,
+    stack_step_groups,
 )
 from parameter_server_tpu.parallel.ssp import DispatchWindow, SSPClock
 from parameter_server_tpu.parallel.workload import WorkloadPool
@@ -169,7 +171,20 @@ class PodTrainer:
         # this process feeds only its own data rows (multi-host contract)
         self.local_data_shards = self.runtime.local_data_shards
         self.updater = updater_from_config(cfg)
-        self.step_fn = make_spmd_train_step(
+        # K microsteps scanned per device call (see SolverConfig.steps_per
+        # _call): amortizes the per-call host->device round-trip floor
+        if cfg.solver.steps_per_call < 1:
+            raise ValueError(
+                f"solver.steps_per_call must be >= 1, got "
+                f"{cfg.solver.steps_per_call}"
+            )
+        self.steps_per_call = cfg.solver.steps_per_call
+        maker = (
+            make_spmd_train_multistep
+            if self.steps_per_call > 1
+            else make_spmd_train_step
+        )
+        self.step_fn = maker(
             self.updater, self.mesh, cfg.data.num_keys,
             push_mode=cfg.parallel.push_mode,
         )
@@ -307,6 +322,16 @@ class PodTrainer:
         return last
 
     @staticmethod
+    def _assemble_group(items: list[tuple]) -> tuple:
+        """Combine K prepared step items into one multistep dispatch item
+        (runs on the pipeline's stacker thread, never the dispatch loop):
+        (stacked (D, K, ...), total examples, per-microstep metas)."""
+        stacked = stack_step_groups([it[0] for it in items])
+        n = sum(it[1] for it in items)
+        metas = [(it[2], it[3]) for it in items]
+        return stacked, n, metas
+
+    @staticmethod
     def _prepare(batches: list[CSRBatch]) -> tuple:
         """Per-step host work: stack D per-worker batches + bookkeeping.
         Runs on the pipeline's stacker thread (or inline when serial).
@@ -337,8 +362,10 @@ class PodTrainer:
 
         from parameter_server_tpu.data.batch import zero_extend
 
+        # trailing axis is the variable one for both single-step (D, NNZ)
+        # and multistep-group (D, K, NNZ) stacks
         local = np.array(
-            [stacked["values"].shape[1], stacked["unique_keys"].shape[1]],
+            [stacked["values"].shape[-1], stacked["unique_keys"].shape[-1]],
             dtype=np.int32,
         )
         nnz_t, u_t = (
@@ -348,10 +375,10 @@ class PodTrainer:
         )
         return {
             **stacked,
-            "unique_keys": zero_extend(stacked["unique_keys"], int(u_t), axis=1),
-            "local_ids": zero_extend(stacked["local_ids"], int(nnz_t), axis=1),
-            "row_ids": zero_extend(stacked["row_ids"], int(nnz_t), axis=1),
-            "values": zero_extend(stacked["values"], int(nnz_t), axis=1),
+            "unique_keys": zero_extend(stacked["unique_keys"], int(u_t), axis=-1),
+            "local_ids": zero_extend(stacked["local_ids"], int(nnz_t), axis=-1),
+            "row_ids": zero_extend(stacked["row_ids"], int(nnz_t), axis=-1),
+            "values": zero_extend(stacked["values"], int(nnz_t), axis=-1),
         }
 
     def _train_epoch(self, streams: list[_WorkerStream], report_every: int) -> dict:
@@ -364,49 +391,84 @@ class PodTrainer:
 
         def _retire(step: int, entry) -> None:
             nonlocal drained
-            loss_arr, examples_arr, probs, labels, n = entry
-            jax.block_until_ready(loss_arr)
+            loss_arr, examples_arr, probs, metas, n = entry
+            # np.asarray blocks until the device call is done (the SSP
+            # bound taking effect); single-step outputs are scalars,
+            # multistep outputs carry a (K,) microstep axis
+            losses = np.atleast_1d(np.asarray(loss_arr))
+            exs = np.atleast_1d(np.asarray(examples_arr))
             self.clock.finish(0, step)
-            if float(examples_arr) == 0.0:
+            # empties only ever trail real batches within a group, so the
+            # LAST microstep's pod-wide count is the drained signal
+            if float(exs[-1]) == 0.0:
                 drained = True
-            window.append(
-                (float(loss_arr), self.runtime.localize_data(probs), labels)
-            )
+            probs_l = self.runtime.localize_data(probs)  # (Dl, [K,] B)
+            if probs_l.ndim == 2:
+                probs_l = probs_l[:, None, :]
+            for k, meta in enumerate(metas):
+                window.append((float(losses[k]), probs_l[:, k, :], meta))
 
         gate = DispatchWindow(self.clock.max_delay, _retire)
+        K = self.steps_per_call
 
         # Host input pipeline (ref: learner/sgd.h parser threads): batch
-        # builds run on background threads; the loop below only pops
-        # ready-stacked step items and dispatches the device step.
+        # builds run on background threads — with K > 1 the K-way group
+        # stacking too (pipeline group_size/assemble) — so the loop below
+        # only pops ready dispatch items and issues the device call.
         depth = self.cfg.data.pipeline_depth
         pipeline = (
-            PrefetchPipeline(streams, self._prepare, depth=depth)
+            PrefetchPipeline(
+                streams, self._prepare, depth=depth,
+                group_size=K,
+                assemble=self._assemble_group if K > 1 else None,
+            )
             if depth > 0
             else None
         )
         empty_item = None  # lazily-built inert step item for drained hosts
+        empty_group = None  # its assembled K-group form
+
+        def _serial_item():
+            batches = [s.next_batch() for s in streams]
+            if not any(b is not None for b in batches):
+                return None
+            return self._prepare(
+                [
+                    b if b is not None else streams[i]._empty()
+                    for i, b in enumerate(batches)
+                ]
+            )
+
+        def _empty_single():
+            nonlocal empty_item
+            if empty_item is None:
+                empty_item = self._prepare([s._empty() for s in streams])
+            return empty_item
+
+        def _empty_dispatch():
+            nonlocal empty_group
+            if K == 1:
+                return _empty_single()
+            if empty_group is None:
+                empty_group = self._assemble_group([_empty_single()] * K)
+            return empty_group
 
         def _next_item():
-            nonlocal empty_item
-            item = None
+            """Next dispatch item: a prepared step (K == 1) or an
+            assembled K-group. Never None — drained hosts keep issuing
+            inert items so every host runs the same collectives until the
+            pod-wide count hits 0."""
             if pipeline is not None:
                 item = pipeline.get()
-            else:
-                batches = [s.next_batch() for s in streams]
-                if any(b is not None for b in batches):
-                    item = self._prepare(
-                        [
-                            b if b is not None else streams[i]._empty()
-                            for i, b in enumerate(batches)
-                        ]
-                    )
-            if item is None:
-                # drained locally: keep issuing inert steps so every host
-                # runs the same collectives until the pod-wide count hits 0
-                if empty_item is None:
-                    empty_item = self._prepare([s._empty() for s in streams])
-                item = empty_item
-            return item
+                return item if item is not None else _empty_dispatch()
+            # serial (pipeline_depth=0) debug path: build inline
+            if K == 1:
+                return _serial_item() or _empty_single()
+            singles = [_serial_item() for _ in range(K)]
+            if all(s is None for s in singles):
+                return _empty_dispatch()
+            singles = [s if s is not None else _empty_single() for s in singles]
+            return self._assemble_group(singles)
 
         # Termination contract (multi-host safe): a host whose local
         # streams dry up keeps issuing steps with all-empty batches — every
@@ -417,24 +479,32 @@ class PodTrainer:
         # index with no blocking host-side barrier on the dispatch path.
         try:
             while True:
-                # SSP gate: block until step (t - tau - 1) fully completed
+                # SSP gate: block until call (t - tau - 1) fully completed
+                # (with K > 1 the gate counts device CALLS, each K
+                # microsteps deep — the documented steps_per_call contract)
                 gate.gate(step_idx)
                 if drained:
                     break
-                stacked_np, n, labels, mask_counts = _next_item()
+                if K == 1:
+                    stacked_np, n, labels, mask_counts = _next_item()
+                    metas = [(labels, mask_counts)]
+                else:
+                    stacked_np, n, metas = _next_item()
                 if self._bucket_sync:
                     stacked_np = self._agree_bucket(stacked_np)
                 stacked = self.runtime.globalize_batch(stacked_np)
-                # push_seed varies per step so quantized-push stochastic
-                # rounding never reuses a key (traced scalar: no recompile)
-                self.state, out = self.step_fn(self.state, stacked, step_idx)
+                # push_seed varies per microstep so quantized-push
+                # stochastic rounding never reuses a key (traced scalar:
+                # no recompile); step_idx * K is this call's first
+                # microstep index
+                self.state, out = self.step_fn(self.state, stacked, step_idx * K)
                 self.examples_seen += n
                 n_since += n
                 gate.add(
                     step_idx,
                     (
                         out["loss_sum"], out["examples"], out["probs"],
-                        (labels, mask_counts), n,
+                        metas, n,
                     ),
                 )
                 self.max_inflight = max(self.max_inflight, gate.max_inflight)

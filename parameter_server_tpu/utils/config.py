@@ -68,6 +68,15 @@ class SolverConfig:
     algo: str = "ftrl"  # ftrl | adagrad | sgd | darlin
     minibatch: int = 4096
     max_delay: int = 0  # SSP bounded delay tau; 0 => BSP, <0 => fully async
+    # microsteps scanned per device call (TPU idiom for the reference's
+    # bounded-delay pipelining of many small Push/Pull tasks): K > 1 runs K
+    # SEQUENTIAL parameter-server steps inside one jitted program — one
+    # host->device transfer, one dispatch, one retirement per K steps —
+    # amortizing the per-call round-trip floor that dominates on tunneled
+    # or dispatch-bound hosts. Same trajectory as K single-step calls;
+    # max_delay then counts device CALLS in flight (each K steps deep).
+    # Honored by the linear_method path (PodTrainer).
+    steps_per_call: int = 1
     epochs: int = 1
     # darlin-only:
     block_iters: int = 20
