@@ -331,7 +331,11 @@ def bench_pipeline_e2e() -> dict:
             write_libsvm(p, labels[s], keys[s], vals[s])
             paths.append(p)
         out["bucket_nnz"] = True
-        for depth, label in ((2, "pipelined"), (0, "serial")):
+        # pipelined_k8: the scanned multistep path (steps_per_call=8) on
+        # top of the threaded pipeline — one transfer/dispatch per 8 steps
+        for depth, k, label in (
+            (2, 8, "pipelined_k8"), (2, 1, "pipelined"), (0, 1, "serial"),
+        ):
             cfg = PSConfig()
             cfg.data.num_keys = NUM_KEYS
             cfg.data.pipeline_depth = depth
@@ -341,6 +345,7 @@ def bench_pipeline_e2e() -> dict:
             cfg.data.bucket_nnz = True
             cfg.data.max_nnz_per_example = 4 * NNZ_PER
             cfg.solver.minibatch = 4096
+            cfg.solver.steps_per_call = k
             cfg.penalty.lambda_l1 = L1
             t = PodTrainer(cfg, reporter=ProgressReporter(print_fn=lambda *_: None))
             t.train_files(paths[:1], report_every=1000)  # compile warmup
@@ -349,41 +354,47 @@ def bench_pipeline_e2e() -> dict:
             dt = time.perf_counter() - t0
             out[f"{label}_ex_per_sec"] = round(n / dt, 1)
             if depth == 2:
-                out["auc"] = round(last.get("auc", float("nan")), 4)
+                out["auc" if k == 1 else "auc_k8"] = round(
+                    last.get("auc", float("nan")), 4
+                )
     return out
 
 
 def bench_w2v() -> dict:
     """word2vec SGNS throughput on the device (BASELINE's second parity
     config): two vocab-sized embedding tables, fused SGNS step, pairs/sec
-    after compile warmup."""
+    after compile warmup. Measured at steps_per_call 1 AND 8: the scanned
+    multistep path amortizes the per-call host<->device round trips that
+    floor-bound the K=1 number on a tunneled chip."""
     from parameter_server_tpu.models.word2vec import Word2Vec
     from parameter_server_tpu.utils.metrics import ProgressReporter
 
     vocab, dim, n_tokens = 1 << 16, 64, 1 << 20
     rng = np.random.default_rng(11)
     corpus = rng.integers(0, vocab, n_tokens)
-    w2v = Word2Vec(
-        vocab_size=vocab, dim=dim, eta=0.1, num_negatives=5, window=2,
-        # SSP run-ahead: without it every step pays a full host<->device
-        # round trip on loss retirement (tunnel-latency bound)
-        max_delay=8,
-        reporter=ProgressReporter(print_fn=lambda *_: None),
-    )
     bs = 8192
-    w2v.train_epoch(corpus[: 1 << 17], batch_size=bs, seed=0)  # warmup
     total = 2 * (2 * n_tokens - 3)  # window=2 skip-gram pair count
     pairs = total // bs * bs  # only full batches are dispatched
-    t0 = time.perf_counter()
-    w2v.train_epoch(corpus, batch_size=bs, seed=1)
-    dt = time.perf_counter() - t0
-    return {
-        "vocab": vocab, "dim": dim, "negatives": 5,
-        "pairs_per_sec": round(pairs / dt, 1),
-        # on the tunneled chip this is floor-bounded by per-step
-        # host->device transfer round trips, not device compute
-        "note": "floor: per-step H2D round trips dominate on a tunnel",
-    }
+    out: dict = {"vocab": vocab, "dim": dim, "negatives": 5}
+    for k in (1, 8):
+        w2v = Word2Vec(
+            vocab_size=vocab, dim=dim, eta=0.1, num_negatives=5, window=2,
+            # SSP run-ahead: without it every call pays a full
+            # host<->device round trip on loss retirement
+            max_delay=8,
+            steps_per_call=k,
+            reporter=ProgressReporter(print_fn=lambda *_: None),
+        )
+        w2v.train_epoch(corpus[: 1 << 17], batch_size=bs, seed=0)  # warmup
+        t0 = time.perf_counter()
+        w2v.train_epoch(corpus, batch_size=bs, seed=1)
+        dt = time.perf_counter() - t0
+        key = "pairs_per_sec" if k == 1 else f"pairs_per_sec_k{k}"
+        out[key] = round(pairs / dt, 1)
+    out["multistep_speedup"] = round(
+        out["pairs_per_sec_k8"] / out["pairs_per_sec"], 3
+    )
+    return out
 
 
 def main() -> None:

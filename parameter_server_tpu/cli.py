@@ -366,6 +366,7 @@ def _run_train_w2v(cfg: PSConfig, args: argparse.Namespace) -> dict:
         num_negatives=w.negatives, window=w.window, seed=cfg.seed,
         mesh=_mesh_from_cfg(cfg), max_delay=max(cfg.solver.max_delay, 0),
         push_mode=cfg.parallel.push_mode,
+        steps_per_call=cfg.solver.steps_per_call,
     )
     # one call: train_files runs its epoch loop internally and pays the
     # vocab-counting pass ONCE, not once per epoch

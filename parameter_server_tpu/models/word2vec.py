@@ -25,14 +25,15 @@ from parameter_server_tpu.kv.updaters import Adagrad, Updater
 from parameter_server_tpu.utils.metrics import ProgressReporter
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3))
-def sgns_train_step(
+def _sgns_micro(
     in_up: Updater,
     out_up: Updater,
     in_state: State,
     out_state: State,
     batch: dict[str, jax.Array],  # center (B,), context (B,), negatives (B, K)
 ) -> tuple[State, State, jax.Array]:
+    """One single-device SGNS step — shared verbatim by the per-step jit
+    and the scanned multistep program so the math cannot diverge."""
     center, context, negatives = batch["center"], batch["context"], batch["negatives"]
     B, K = negatives.shape
 
@@ -56,6 +57,39 @@ def sgns_train_step(
     return new_in, new_out, loss
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3))
+def sgns_train_step(
+    in_up: Updater,
+    out_up: Updater,
+    in_state: State,
+    out_state: State,
+    batch: dict[str, jax.Array],
+) -> tuple[State, State, jax.Array]:
+    return _sgns_micro(in_up, out_up, in_state, out_state, batch)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3))
+def sgns_train_multistep(
+    in_up: Updater,
+    out_up: Updater,
+    in_state: State,
+    out_state: State,
+    batch: dict[str, jax.Array],  # fields carry a leading (K_steps, ...) axis
+) -> tuple[State, State, jax.Array]:
+    """K sequential SGNS steps scanned on-device in one dispatch (the
+    steps_per_call idiom of parallel.spmd.make_spmd_train_multistep:
+    amortize the per-call host<->device round-trip floor). Returns the
+    summed loss over microsteps."""
+
+    def body(carry, mb):
+        in_s, out_s = carry
+        new_in, new_out, loss = _sgns_micro(in_up, out_up, in_s, out_s, mb)
+        return (new_in, new_out), loss
+
+    (in_s, out_s), losses = jax.lax.scan(body, (in_state, out_state), batch)
+    return in_s, out_s, jnp.sum(losses)
+
+
 def _sgns_weights_math(u, v_flat, B, K, mask=None):
     """SGNS loss/grads from materialized weights, shared verbatim by the
     single-device and SPMD steps.
@@ -77,40 +111,19 @@ def _sgns_weights_math(u, v_flat, B, K, mask=None):
     return loss, g_u, g_v
 
 
-def make_w2v_spmd_train_step(
-    in_up: Updater, out_up: Updater, mesh, vocab_size: int,
-    push_mode: str = "per_worker",
-):
-    """SGNS step over the (data, kv) mesh: BOTH embedding tables are
-    range-sharded over "kv" (the server tables), pair batches over "data"
-    (the workers) — same layout as the MF app (BASELINE word2vec config:
-    the classic two-huge-tables parameter-server workload).
-
-    push_mode "aggregate" pre-sums per-key grads across data shards with
-    one psum per table and applies ONE updater step (the north star's
-    "push ≡ reduce-scatter") — the win matters most here, where the
-    (B·(1+K), dim) output-table push makes the all-gather the most
-    expensive part of the per_worker path. Standard sync aggregation for
-    AdaGrad (same fixed point, different trajectory)."""
-    import functools
-
-    from jax import lax, shard_map
-    from jax.sharding import PartitionSpec as P
+def _make_w2v_local_micro(in_up, out_up, shard: int, push_mode: str):
+    """Per-device SGNS microstep over the (data, kv) mesh — shared by the
+    single-step and scanned multistep shard_map programs. Returns the
+    LOCAL (un-psummed) loss."""
+    from jax import lax
 
     from parameter_server_tpu.parallel.spmd import (
         _local_pull,
         _local_push,
         _local_push_aggregate,
-        _shard_size,
-        state_spec,
     )
 
-    if push_mode not in ("per_worker", "aggregate"):
-        raise ValueError(f"unknown push_mode {push_mode!r}")
-    shard = _shard_size(vocab_size, mesh.shape["kv"])
-
-    def local_step(in_l, out_l, batch):
-        b = {k: v[0] for k, v in batch.items()}
+    def micro(in_l, out_l, b):
         center, context, negatives = b["center"], b["context"], b["negatives"]
         B, K = negatives.shape
         out_ids = jnp.concatenate(
@@ -131,7 +144,44 @@ def make_w2v_spmd_train_step(
                 out_up, out_l, lax.all_gather(out_ids, "data"),
                 lax.all_gather(g_v, "data"), shard,
             )
-        return new_in, new_out, lax.psum(loss, "data")
+        return new_in, new_out, loss
+
+    return micro
+
+
+def _make_w2v_spmd(
+    in_up: Updater, out_up: Updater, mesh, vocab_size: int,
+    push_mode: str, multistep: bool,
+):
+    """Shared builder for the K=1 and scanned-K w2v mesh programs (one
+    home for validation, specs, and the jit contract, so the single/multi
+    pair cannot silently diverge — the _wrap_stepper pattern of
+    parallel.spmd)."""
+    import functools
+
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from parameter_server_tpu.parallel.spmd import _shard_size, state_spec
+
+    if push_mode not in ("per_worker", "aggregate"):
+        raise ValueError(f"unknown push_mode {push_mode!r}")
+    micro = _make_w2v_local_micro(
+        in_up, out_up, _shard_size(vocab_size, mesh.shape["kv"]), push_mode
+    )
+
+    def local_step(in_l, out_l, batch):
+        b = {k: v[0] for k, v in batch.items()}
+        if not multistep:
+            new_in, new_out, loss = micro(in_l, out_l, b)
+            return new_in, new_out, lax.psum(loss, "data")
+
+        def body(carry, mb):  # b fields carry a leading (K_steps, ...) axis
+            new_in, new_out, loss = micro(carry[0], carry[1], mb)
+            return (new_in, new_out), loss
+
+        (in_s, out_s), losses = lax.scan(body, (in_l, out_l), b)
+        return in_s, out_s, lax.psum(jnp.sum(losses), "data")
 
     step = shard_map(
         local_step,
@@ -148,12 +198,54 @@ def make_w2v_spmd_train_step(
     return jitted
 
 
-def _stack_w2v_batches(batches: list[dict], mesh) -> dict:
-    """Stack D per-worker pair batches on a leading axis, sharded over
-    "data" (negatives keep their trailing (B, K) shape)."""
-    return _place_w2v_stacked(
-        {k: np.stack([b[k] for b in batches]) for k in batches[0]}, mesh
+def make_w2v_spmd_train_step(
+    in_up: Updater, out_up: Updater, mesh, vocab_size: int,
+    push_mode: str = "per_worker",
+):
+    """SGNS step over the (data, kv) mesh: BOTH embedding tables are
+    range-sharded over "kv" (the server tables), pair batches over "data"
+    (the workers) — same layout as the MF app (BASELINE word2vec config:
+    the classic two-huge-tables parameter-server workload).
+
+    push_mode "aggregate" pre-sums per-key grads across data shards with
+    one psum per table and applies ONE updater step (the north star's
+    "push ≡ reduce-scatter") — the win matters most here, where the
+    (B·(1+K), dim) output-table push makes the all-gather the most
+    expensive part of the per_worker path. Standard sync aggregation for
+    AdaGrad (same fixed point, different trajectory)."""
+    return _make_w2v_spmd(
+        in_up, out_up, mesh, vocab_size, push_mode, multistep=False
     )
+
+
+def make_w2v_spmd_train_multistep(
+    in_up: Updater, out_up: Updater, mesh, vocab_size: int,
+    push_mode: str = "per_worker",
+):
+    """K sequential SGNS steps per device call over the (data, kv) mesh:
+    batch fields are stacked (D, K_steps, ...) — data shard leading
+    (sharded), microstep second (lax.scan'd). One transfer + one dispatch
+    per K steps (the steps_per_call idiom; see
+    parallel.spmd.make_spmd_train_multistep). Returns the summed loss."""
+    return _make_w2v_spmd(
+        in_up, out_up, mesh, vocab_size, push_mode, multistep=True
+    )
+
+
+def _group_microbatches(items: list[dict], k_steps: int, axis: int) -> dict:
+    """Stack up to K per-microstep host batch dicts on a NEW microstep
+    axis (axis 0 for single-device (B, ...) items, axis 1 for mesh-stacked
+    (D, ...) items) for the scanned multistep programs. A ones mask is
+    added where absent, and a partial final group is padded with all-zero
+    microsteps — mask 0 makes them inert (zero loss, zero gradient)."""
+    items = [
+        dict(b, mask=b.get("mask", np.ones_like(b["center"], dtype=np.float32)))
+        for b in items
+    ]
+    if len(items) < k_steps:
+        pad = {k: np.zeros_like(v) for k, v in items[0].items()}
+        items = items + [pad] * (k_steps - len(items))
+    return {k: np.stack([b[k] for b in items], axis=axis) for k in items[0]}
 
 
 def _place_w2v_stacked(stacked: dict, mesh) -> dict:
@@ -402,6 +494,7 @@ class Word2Vec:
         mesh=None,
         max_delay: int = 0,
         push_mode: str = "per_worker",
+        steps_per_call: int = 1,
     ):
         self.vocab_size = vocab_size
         self.dim = dim
@@ -413,6 +506,13 @@ class Word2Vec:
         self.mesh = mesh
         self.max_delay = max_delay  # SSP dispatch bound (ref: BASELINE's
         # "bounded-staleness SSP" word2vec config)
+        # K sequential SGNS steps scanned per device call (the
+        # solver.steps_per_call idiom): amortizes the per-call
+        # host<->device round-trip floor; max_delay then counts device
+        # CALLS in flight (each K steps deep)
+        if steps_per_call < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+        self.steps_per_call = steps_per_call
         rng = np.random.default_rng(seed)
         self.in_state = self.in_up.init(vocab_size, dim)
         self.out_state = self.out_up.init(vocab_size, dim)
@@ -424,7 +524,12 @@ class Word2Vec:
         if mesh is not None:
             from parameter_server_tpu.parallel.spmd import shard_state
 
-            self._spmd_step = make_w2v_spmd_train_step(
+            maker = (
+                make_w2v_spmd_train_multistep
+                if steps_per_call > 1
+                else make_w2v_spmd_train_step
+            )
+            self._spmd_step = maker(
                 self.in_up, self.out_up, mesh, vocab_size, push_mode=push_mode
             )
             self.in_state = shard_state(self.in_state, mesh)
@@ -447,6 +552,36 @@ class Word2Vec:
             "context": contexts[sel].astype(np.int32),
             "negatives": sampler.sample((len(sel), self.K)).astype(np.int32),
         }
+
+    def _dispatch_prepared(self, batch_np: dict, k_steps: int):
+        """Issue ONE device call on ready host arrays (already
+        microstep-grouped when ``k_steps > 1``); returns the device loss
+        (sum over the call's microsteps, unretired)."""
+        if self.mesh is not None:
+            batch = _place_w2v_stacked(batch_np, self.mesh)
+            self.in_state, self.out_state, loss = self._spmd_step(
+                self.in_state, self.out_state, batch
+            )
+            return loss
+        batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+        fn = sgns_train_multistep if k_steps > 1 else sgns_train_step
+        self.in_state, self.out_state, loss = fn(
+            self.in_up, self.out_up, self.in_state, self.out_state, batch
+        )
+        return loss
+
+    def _dispatch(self, micro: list[dict], k_steps: int):
+        """Group up to ``k_steps`` microstep batches (mesh-stacked
+        (D, ...) dicts when a mesh is set, plain (B, ...) dicts otherwise)
+        inline and issue one device call — the in-memory and serial/debug
+        paths; the streaming pipeline assembles groups on its stacker
+        thread instead (see _train_stream)."""
+        if k_steps == 1:
+            return self._dispatch_prepared(micro[0], 1)
+        axis = 1 if self.mesh is not None else 0
+        return self._dispatch_prepared(
+            _group_microbatches(micro, k_steps, axis), k_steps
+        )
 
     def train_epoch(
         self,
@@ -476,32 +611,35 @@ class Word2Vec:
             total_loss += float(loss_arr)  # sync point, bounded by the gate
 
         gate = DispatchWindow(self.max_delay, _retire)
-        step_i = 0
-        for s in range(0, len(order) - global_bs + 1, global_bs):
-            sel = order[s : s + global_bs]
-            # SSP gate: retire steps <= t - tau - 1 before dispatching t
-            gate.gate(step_i)
-            if self.mesh is not None:
-                subs = [
-                    self._make_batch(
-                        centers, contexts, sampler,
-                        sel[d * batch_size : (d + 1) * batch_size],
+        K_steps = self.steps_per_call
+        starts = list(range(0, len(order) - global_bs + 1, global_bs))
+        call_i = 0
+        for c in range(0, len(starts), K_steps):
+            chunk = starts[c : c + K_steps]
+            # SSP gate: retire calls <= t - tau - 1 before dispatching t
+            gate.gate(call_i)
+            micro = []  # host batch dict per microstep in this call
+            for s in chunk:
+                sel = order[s : s + global_bs]
+                if self.mesh is not None:
+                    subs = [
+                        self._make_batch(
+                            centers, contexts, sampler,
+                            sel[d * batch_size : (d + 1) * batch_size],
+                        )
+                        for d in range(D)
+                    ]
+                    micro.append(
+                        {k: np.stack([b[k] for b in subs]) for k in subs[0]}
                     )
-                    for d in range(D)
-                ]
-                batch = _stack_w2v_batches(subs, self.mesh)
-                self.in_state, self.out_state, loss = self._spmd_step(
-                    self.in_state, self.out_state, batch
-                )
-            else:
-                b = self._make_batch(centers, contexts, sampler, sel)
-                batch = {k: jnp.asarray(v) for k, v in b.items()}
-                self.in_state, self.out_state, loss = sgns_train_step(
-                    self.in_up, self.out_up, self.in_state, self.out_state, batch
-                )
-            gate.add(step_i, loss)
-            n += len(sel)
-            step_i += 1
+                else:
+                    micro.append(
+                        self._make_batch(centers, contexts, sampler, sel)
+                    )
+                n += len(sel)
+            loss = self._dispatch(micro, K_steps)
+            gate.add(call_i, loss)
+            call_i += 1
         gate.drain()
         mean = total_loss / max(n, 1)
         self.reporter.report(
@@ -577,8 +715,34 @@ class Word2Vec:
             total_loss += float(loss_arr)
 
         gate = DispatchWindow(self.max_delay, _retire)
-        if pipeline_depth > 0:
-            pipeline = PrefetchPipeline(streams, prepare, depth=pipeline_depth)
+        K_steps = self.steps_per_call
+
+        def _strip(stacked: dict) -> dict:
+            # mesh batches stay (D, ...)-stacked; single-device takes its
+            # lone shard's (B, ...) view
+            return (
+                stacked
+                if self.mesh is not None
+                else {k: v[0] for k, v in stacked.items()}
+            )
+
+        def assemble(items: list[tuple]) -> tuple[dict, int]:
+            # K-way group stacking ON the pipeline's stacker thread (the
+            # trainer's group_size/assemble pattern): the dispatch loop
+            # below only pops ready device-call payloads
+            grouped = _group_microbatches(
+                [_strip(it[0]) for it in items], K_steps,
+                axis=1 if self.mesh is not None else 0,
+            )
+            return grouped, sum(it[1] for it in items)
+
+        piped = pipeline_depth > 0
+        if piped:
+            pipeline = PrefetchPipeline(
+                streams, prepare, depth=pipeline_depth,
+                group_size=K_steps,
+                assemble=assemble if K_steps > 1 else None,
+            )
             next_item = pipeline.get
         else:
             pipeline = contextlib.nullcontext()
@@ -594,28 +758,38 @@ class Word2Vec:
                     ]
                 )
 
-        step_i = 0
+        call_i = 0
         with pipeline:
             while True:
-                gate.gate(step_i)
-                item = next_item()
-                if item is None:
-                    break
-                stacked, n = item
-                if self.mesh is not None:
-                    batch = _place_w2v_stacked(stacked, self.mesh)
-                    self.in_state, self.out_state, loss = self._spmd_step(
-                        self.in_state, self.out_state, batch
-                    )
-                else:
-                    b = {k: jnp.asarray(v[0]) for k, v in stacked.items()}
-                    self.in_state, self.out_state, loss = sgns_train_step(
-                        self.in_up, self.out_up,
-                        self.in_state, self.out_state, b,
-                    )
-                gate.add(step_i, loss)
-                n_pairs += n
-                step_i += 1
+                gate.gate(call_i)
+                if piped and K_steps > 1:
+                    item = next_item()  # pre-assembled (grouped, n)
+                    if item is None:
+                        break
+                    grouped, n = item
+                    n_pairs += n
+                    loss = self._dispatch_prepared(grouped, K_steps)
+                elif K_steps == 1:
+                    item = next_item()
+                    if item is None:
+                        break
+                    stacked, n = item
+                    n_pairs += n
+                    loss = self._dispatch([_strip(stacked)], 1)
+                else:  # serial/debug path: group inline
+                    micro = []
+                    for _ in range(K_steps):
+                        item = next_item()
+                        if item is None:
+                            break
+                        stacked, n = item
+                        micro.append(_strip(stacked))
+                        n_pairs += n
+                    if not micro:
+                        break
+                    loss = self._dispatch(micro, K_steps)
+                gate.add(call_i, loss)
+                call_i += 1
             gate.drain()
         return total_loss, n_pairs
 
