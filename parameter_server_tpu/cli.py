@@ -322,6 +322,7 @@ def _run_train_mf(cfg: PSConfig, args: argparse.Namespace) -> dict:
         algo=m.algo, seed=cfg.seed, mesh=_mesh_from_cfg(cfg),
         push_mode=cfg.parallel.push_mode,
         max_delay=max(cfg.solver.max_delay, 0),
+        steps_per_call=cfg.solver.steps_per_call,
     )
     rmse = app.train_files(
         cfg.data.files, batch_size=m.batch_size,

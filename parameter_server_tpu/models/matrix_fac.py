@@ -23,6 +23,7 @@ import numpy as np
 
 from parameter_server_tpu.kv.store import State
 from parameter_server_tpu.kv.updaters import Adagrad, Sgd, Updater
+from parameter_server_tpu.parallel.spmd import place_stacked
 from parameter_server_tpu.utils.config import PSConfig
 from parameter_server_tpu.utils.hashing import PAD_KEY
 from parameter_server_tpu.utils.metrics import ProgressReporter
@@ -80,14 +81,7 @@ class MFBatchBuilder:
 
 
 def batch_to_device(b: MFBatch) -> dict[str, jax.Array]:
-    return {
-        "user_keys": jnp.asarray(b.user_keys),
-        "item_keys": jnp.asarray(b.item_keys),
-        "user_ids": jnp.asarray(b.user_ids),
-        "item_ids": jnp.asarray(b.item_ids),
-        "ratings": jnp.asarray(b.ratings),
-        "mask": jnp.asarray(b.mask),
-    }
+    return {k: jnp.asarray(v) for k, v in _mf_host_dict(b).items()}
 
 
 def _mf_loss_and_grads(
@@ -111,8 +105,7 @@ def _mf_loss_and_grads(
     return loss, g_u, g_v
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3))
-def mf_train_step(
+def _mf_micro(
     user_up: Updater,
     item_up: Updater,
     user_state: State,
@@ -120,7 +113,8 @@ def mf_train_step(
     batch: dict[str, jax.Array],
     l2: float,
 ) -> tuple[State, State, jax.Array]:
-    """One fused MF step: pull touched factors, SSE gradient, push both."""
+    """One fused MF step: pull touched factors, SSE gradient, push both —
+    shared verbatim by the per-step jit and the scanned multistep."""
     uk, ik = batch["user_keys"], batch["item_keys"]
     u_rows = {k: jnp.take(v, uk, axis=0) for k, v in user_state.items()}
     i_rows = {k: jnp.take(v, ik, axis=0) for k, v in item_state.items()}
@@ -134,6 +128,115 @@ def mf_train_step(
     new_user = {k: user_state[k].at[uk].add(du[k]) for k in user_state}
     new_item = {k: item_state[k].at[ik].add(dv[k]) for k in item_state}
     return new_user, new_item, loss
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3))
+def mf_train_step(
+    user_up: Updater,
+    item_up: Updater,
+    user_state: State,
+    item_state: State,
+    batch: dict[str, jax.Array],
+    l2: float,
+) -> tuple[State, State, jax.Array]:
+    return _mf_micro(user_up, item_up, user_state, item_state, batch, l2)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3))
+def mf_train_multistep(
+    user_up: Updater,
+    item_up: Updater,
+    user_state: State,
+    item_state: State,
+    batch: dict[str, jax.Array],  # fields carry a leading (K_steps, ...) axis
+    l2: float,
+) -> tuple[State, State, jax.Array]:
+    """K sequential MF steps scanned on-device in one dispatch (the
+    steps_per_call idiom; see parallel.spmd.make_spmd_train_multistep).
+    Returns the summed loss over microsteps."""
+
+    def body(carry, mb):
+        new_u, new_i, loss = _mf_micro(user_up, item_up, carry[0], carry[1], mb, l2)
+        return (new_u, new_i), loss
+
+    (us, its), losses = jax.lax.scan(body, (user_state, item_state), batch)
+    return us, its, jnp.sum(losses)
+
+
+def _make_mf_spmd(
+    user_up: Updater,
+    item_up: Updater,
+    mesh,
+    num_user_rows: int,
+    num_item_rows: int,
+    l2: float,
+    push_mode: str,
+    multistep: bool,
+):
+    """Shared builder for the K=1 and scanned-K MF mesh programs (one home
+    for validation, specs, and the jit contract)."""
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from parameter_server_tpu.parallel.spmd import (
+        _local_pull,
+        _local_push,
+        _local_push_aggregate,
+        _shard_size,
+        batch_spec,
+        state_spec,
+    )
+
+    if push_mode not in ("per_worker", "aggregate"):
+        raise ValueError(f"unknown push_mode {push_mode!r}")
+    u_shard = _shard_size(num_user_rows, mesh.shape["kv"])
+    i_shard = _shard_size(num_item_rows, mesh.shape["kv"])
+
+    def micro(user_l, item_l, b):
+        uk, ik = b["user_keys"], b["item_keys"]
+        U = lax.psum(_local_pull(user_up, user_l, uk, u_shard), "kv")
+        V = lax.psum(_local_pull(item_up, item_l, ik, i_shard), "kv")
+        loss, g_u, g_v = _mf_loss_and_grads(U, V, b, l2)
+        if push_mode == "aggregate":
+            new_user = _local_push_aggregate(user_up, user_l, uk, g_u, u_shard)
+            new_item = _local_push_aggregate(item_up, item_l, ik, g_v, i_shard)
+        else:
+            new_user = _local_push(
+                user_up, user_l, lax.all_gather(uk, "data"),
+                lax.all_gather(g_u, "data"), u_shard,
+            )
+            new_item = _local_push(
+                item_up, item_l, lax.all_gather(ik, "data"),
+                lax.all_gather(g_v, "data"), i_shard,
+            )
+        return new_user, new_item, loss
+
+    def local_step(user_l, item_l, batch):
+        b = {k: v[0] for k, v in batch.items()}
+        if not multistep:
+            new_user, new_item, loss = micro(user_l, item_l, b)
+            return new_user, new_item, lax.psum(loss, "data")
+
+        def body(carry, mb):  # b fields carry a leading (K_steps, ...) axis
+            new_u, new_i, loss = micro(carry[0], carry[1], mb)
+            return (new_u, new_i), loss
+
+        (us, its), losses = lax.scan(body, (user_l, item_l), b)
+        return us, its, lax.psum(jnp.sum(losses), "data")
+
+    step = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec(), state_spec(), batch_spec()),
+        out_specs=(state_spec(), state_spec(), P()),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def jitted(user_state, item_state, batch):
+        return step(user_state, item_state, batch)
+
+    return jitted
 
 
 def make_mf_spmd_train_step(
@@ -153,68 +256,51 @@ def make_mf_spmd_train_step(
     with one psum per table and apply ONE updater step (see
     parallel/spmd._local_push_aggregate — exactly equal to per_worker for
     plain SGD, standard sync aggregation for AdaGrad)."""
-
-    from jax import lax, shard_map
-    from jax.sharding import PartitionSpec as P
-
-    from parameter_server_tpu.parallel.spmd import (
-        _local_pull,
-        _local_push,
-        _local_push_aggregate,
-        _shard_size,
-        batch_spec,
-        state_spec,
+    return _make_mf_spmd(
+        user_up, item_up, mesh, num_user_rows, num_item_rows, l2,
+        push_mode, multistep=False,
     )
 
-    if push_mode not in ("per_worker", "aggregate"):
-        raise ValueError(f"unknown push_mode {push_mode!r}")
-    u_shard = _shard_size(num_user_rows, mesh.shape["kv"])
-    i_shard = _shard_size(num_item_rows, mesh.shape["kv"])
 
-    def local_step(user_l, item_l, batch):
-        b = {k: v[0] for k, v in batch.items()}
-        uk, ik = b["user_keys"], b["item_keys"]
-        U = lax.psum(_local_pull(user_up, user_l, uk, u_shard), "kv")
-        V = lax.psum(_local_pull(item_up, item_l, ik, i_shard), "kv")
-        loss, g_u, g_v = _mf_loss_and_grads(U, V, b, l2)
-        if push_mode == "aggregate":
-            new_user = _local_push_aggregate(user_up, user_l, uk, g_u, u_shard)
-            new_item = _local_push_aggregate(item_up, item_l, ik, g_v, i_shard)
-        else:
-            new_user = _local_push(
-                user_up, user_l, lax.all_gather(uk, "data"),
-                lax.all_gather(g_u, "data"), u_shard,
-            )
-            new_item = _local_push(
-                item_up, item_l, lax.all_gather(ik, "data"),
-                lax.all_gather(g_v, "data"), i_shard,
-            )
-        return new_user, new_item, lax.psum(loss, "data")
-
-    step = shard_map(
-        local_step,
-        mesh=mesh,
-        in_specs=(state_spec(), state_spec(), batch_spec()),
-        out_specs=(state_spec(), state_spec(), P()),
-        check_vma=False,
+def make_mf_spmd_train_multistep(
+    user_up: Updater,
+    item_up: Updater,
+    mesh,
+    num_user_rows: int,
+    num_item_rows: int,
+    l2: float,
+    push_mode: str = "per_worker",
+):
+    """K sequential MF steps per device call over the (data, kv) mesh:
+    batch fields stacked (D, K_steps, ...) — data shard leading (sharded),
+    microstep second (lax.scan'd). Returns the summed loss."""
+    return _make_mf_spmd(
+        user_up, item_up, mesh, num_user_rows, num_item_rows, l2,
+        push_mode, multistep=True,
     )
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def jitted(user_state, item_state, batch):
-        return step(user_state, item_state, batch)
 
-    return jitted
+_MF_FIELDS = ("user_keys", "item_keys", "user_ids", "item_ids", "ratings", "mask")
 
 
 def stack_mf_batches(batches: list[MFBatch], mesh=None) -> dict[str, jax.Array]:
     """Stack per-worker MFBatches on a leading axis, sharded over data."""
     from parameter_server_tpu.parallel.spmd import stack_fields
 
-    return stack_fields(
-        batches,
-        ("user_keys", "item_keys", "user_ids", "item_ids", "ratings", "mask"),
-        mesh,
-    )
+    return stack_fields(batches, _MF_FIELDS, mesh)
+
+
+def _mf_host_dict(b: MFBatch) -> dict[str, np.ndarray]:
+    return {f: getattr(b, f) for f in _MF_FIELDS}
+
+
+def _group_mf(items: list[dict], k_steps: int, axis: int, empty: dict) -> dict:
+    """Stack up to K per-microstep host dicts on a NEW microstep axis for
+    the scanned multistep programs; a partial final group is padded with
+    the inert ``empty`` dict (mask 0 => zero loss and zero gradient)."""
+    if len(items) < k_steps:
+        items = items + [empty] * (k_steps - len(items))
+    return {k: np.stack([b[k] for b in items], axis=axis) for k in items[0]}
 
 
 def iter_rating_blocks(
@@ -276,9 +362,17 @@ class MatrixFactorization:
         mesh=None,
         push_mode: str = "per_worker",
         max_delay: int = 0,
+        steps_per_call: int = 1,
     ):
         self.rank = rank
         self.l2 = l2
+        # K sequential MF steps scanned per device call (the
+        # solver.steps_per_call idiom): amortizes the per-call
+        # host<->device round-trip floor; max_delay then counts device
+        # CALLS in flight (each K steps deep)
+        if steps_per_call < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+        self.steps_per_call = steps_per_call
         self.reporter = reporter or ProgressReporter()
         make = {"adagrad": lambda: Adagrad(eta=eta), "sgd": lambda: Sgd(eta=eta)}
         if algo not in make:
@@ -312,7 +406,12 @@ class MatrixFactorization:
                     )
             from parameter_server_tpu.parallel.spmd import shard_state
 
-            self._spmd_step = make_mf_spmd_train_step(
+            maker = (
+                make_mf_spmd_train_multistep
+                if steps_per_call > 1
+                else make_mf_spmd_train_step
+            )
+            self._spmd_step = maker(
                 self.user_up, self.item_up, mesh,
                 num_users + 1, num_items + 1, l2=l2, push_mode=push_mode,
             )
@@ -335,7 +434,8 @@ class MatrixFactorization:
             sse += float(loss_arr)
 
         gate = DispatchWindow(self.max_delay, _retire)
-        step_i = 0
+        K = self.steps_per_call
+        call_i = 0
         if self.mesh is not None:
             D = self.mesh.shape["data"]
             global_bs = batch_size * D
@@ -343,38 +443,71 @@ class MatrixFactorization:
                 np.zeros(0, np.int64), np.zeros(0, np.int64),
                 np.zeros(0, np.float32),
             )
-            for s in range(0, len(ratings), global_bs):
-                gate.gate(step_i)
-                subs = []
-                for d in range(D):
-                    sel = slice(s + d * batch_size, s + (d + 1) * batch_size)
-                    if len(ratings[sel]):
-                        subs.append(
-                            builder.build(users[sel], items[sel], ratings[sel])
-                        )
-                    else:
-                        subs.append(empty)
+            empty_stacked = None  # lazily built pad for partial K-groups
+            starts = list(range(0, len(ratings), global_bs))
+            for c in range(0, len(starts), K):
+                gate.gate(call_i)
+                micro = []  # per-microstep (D, ...) host stacks
+                for s in starts[c : c + K]:
+                    subs = []
+                    for d in range(D):
+                        sel = slice(s + d * batch_size, s + (d + 1) * batch_size)
+                        if len(ratings[sel]):
+                            subs.append(
+                                builder.build(users[sel], items[sel], ratings[sel])
+                            )
+                        else:
+                            subs.append(empty)
+                    micro.append(stack_mf_batches(subs, None))
+                    n += sum(b.num_pairs for b in subs)
+                if K == 1:
+                    batch = place_stacked(micro[0], self.mesh)
+                else:
+                    if len(micro) < K and empty_stacked is None:
+                        empty_stacked = stack_mf_batches([empty] * D, None)
+                    batch = place_stacked(
+                        _group_mf(micro, K, axis=1, empty=empty_stacked),
+                        self.mesh,
+                    )
                 self.user_state, self.item_state, loss = self._spmd_step(
-                    self.user_state, self.item_state,
-                    stack_mf_batches(subs, self.mesh),
+                    self.user_state, self.item_state, batch
                 )
-                gate.add(step_i, loss)
-                step_i += 1
-                n += sum(b.num_pairs for b in subs)
+                gate.add(call_i, loss)
+                call_i += 1
             gate.drain()
             return sse, n
-        for s in range(0, len(ratings), batch_size):
-            gate.gate(step_i)
-            sel = slice(s, s + batch_size)
-            b = builder.build(users[sel], items[sel], ratings[sel])
-            dev = batch_to_device(b)
-            self.user_state, self.item_state, loss = mf_train_step(
-                self.user_up, self.item_up,
-                self.user_state, self.item_state, dev, self.l2,
-            )
-            gate.add(step_i, loss)
-            step_i += 1
-            n += b.num_pairs
+        empty_host = None
+        starts = list(range(0, len(ratings), batch_size))
+        for c in range(0, len(starts), K):
+            gate.gate(call_i)
+            hosts = []
+            for s in starts[c : c + K]:
+                sel = slice(s, s + batch_size)
+                b = builder.build(users[sel], items[sel], ratings[sel])
+                hosts.append(_mf_host_dict(b))
+                n += b.num_pairs
+            if K == 1:
+                dev = {k: jnp.asarray(v) for k, v in hosts[0].items()}
+                self.user_state, self.item_state, loss = mf_train_step(
+                    self.user_up, self.item_up,
+                    self.user_state, self.item_state, dev, self.l2,
+                )
+            else:
+                if len(hosts) < K and empty_host is None:
+                    empty_host = _mf_host_dict(
+                        builder.build(
+                            np.zeros(0, np.int64), np.zeros(0, np.int64),
+                            np.zeros(0, np.float32),
+                        )
+                    )
+                grouped = _group_mf(hosts, K, axis=0, empty=empty_host)
+                dev = {k: jnp.asarray(v) for k, v in grouped.items()}
+                self.user_state, self.item_state, loss = mf_train_multistep(
+                    self.user_up, self.item_up,
+                    self.user_state, self.item_state, dev, self.l2,
+                )
+            gate.add(call_i, loss)
+            call_i += 1
         gate.drain()
         return sse, n
 

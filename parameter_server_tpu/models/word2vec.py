@@ -248,14 +248,6 @@ def _group_microbatches(items: list[dict], k_steps: int, axis: int) -> dict:
     return {k: np.stack([b[k] for b in items], axis=axis) for k in items[0]}
 
 
-def _place_w2v_stacked(stacked: dict, mesh) -> dict:
-    """Place already-stacked (D, ...) host arrays sharded over "data"."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    sh = NamedSharding(mesh, P("data"))
-    return {k: jax.device_put(v, sh) for k, v in stacked.items()}
-
-
 class NegativeSampler:
     """unigram^0.75 sampler (word2vec's standard trick): inverse-CDF via
     searchsorted — O(log V) per draw, no per-call table rebuild (rng.choice
@@ -558,7 +550,9 @@ class Word2Vec:
         microstep-grouped when ``k_steps > 1``); returns the device loss
         (sum over the call's microsteps, unretired)."""
         if self.mesh is not None:
-            batch = _place_w2v_stacked(batch_np, self.mesh)
+            from parameter_server_tpu.parallel.spmd import place_stacked
+
+            batch = place_stacked(batch_np, self.mesh)
             self.in_state, self.out_state, loss = self._spmd_step(
                 self.in_state, self.out_state, batch
             )

@@ -65,10 +65,14 @@ def stack_fields(
     import numpy as np
 
     out = {f: np.stack([getattr(b, f) for b in batches]) for f in fields}
-    if mesh is None:
-        return out
+    return out if mesh is None else place_stacked(out, mesh)
+
+
+def place_stacked(stacked: dict, mesh: Mesh) -> dict:
+    """Place already-stacked (D, ...) host arrays sharded over "data" —
+    the one home for the data-axis placement spec (apps share it)."""
     sh = NamedSharding(mesh, batch_spec())
-    return {k: jax.device_put(v, sh) for k, v in out.items()}
+    return {k: jax.device_put(v, sh) for k, v in stacked.items()}
 
 
 def stack_batches(batches: list[CSRBatch], mesh: Mesh | None = None) -> Batch:

@@ -75,8 +75,8 @@ class SolverConfig:
     # amortizing the per-call round-trip floor that dominates on tunneled
     # or dispatch-bound hosts. Same trajectory as K single-step calls;
     # max_delay then counts device CALLS in flight (each K steps deep).
-    # Honored by the linear_method path (PodTrainer) and the word2vec app
-    # (Word2Vec(steps_per_call=...), wired from this field by the CLI).
+    # Honored by the linear_method path (PodTrainer) and the word2vec and
+    # matrix_fac apps (steps_per_call=..., wired from this field by the CLI).
     steps_per_call: int = 1
     epochs: int = 1
     # darlin-only:
