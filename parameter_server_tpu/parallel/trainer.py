@@ -331,15 +331,16 @@ class PodTrainer:
         metas = [(it[2], it[3]) for it in items]
         return stacked, n, metas
 
-    @staticmethod
-    def _prepare(batches: list[CSRBatch]) -> tuple:
+    def _prepare(self, batches: list[CSRBatch]) -> tuple:
         """Per-step host work: stack D per-worker batches + bookkeeping.
         Runs on the pipeline's stacker thread (or inline when serial).
         Bucketed batches are first re-padded to the group max (buckets are
         powers of two, so group shapes stay a small compiled set)."""
         from parameter_server_tpu.data.batch import pad_group
 
-        stacked = stack_batches(pad_group(batches), None)
+        stacked = stack_batches(
+            pad_group(batches), None, compact=self.cfg.data.compact_wire
+        )
         n = sum(b.num_examples for b in batches)
         labels = np.concatenate([b.labels[: b.num_examples] for b in batches])
         counts = [b.num_examples for b in batches]
@@ -373,13 +374,15 @@ class PodTrainer:
             .reshape(-1, 2)
             .max(axis=0)
         )
-        return {
+        out = {
             **stacked,
             "unique_keys": zero_extend(stacked["unique_keys"], int(u_t), axis=-1),
             "local_ids": zero_extend(stacked["local_ids"], int(nnz_t), axis=-1),
-            "row_ids": zero_extend(stacked["row_ids"], int(nnz_t), axis=-1),
             "values": zero_extend(stacked["values"], int(nnz_t), axis=-1),
         }
+        if "row_ids" in stacked:  # absent in the compact wire format
+            out["row_ids"] = zero_extend(stacked["row_ids"], int(nnz_t), axis=-1)
+        return out
 
     def _train_epoch(self, streams: list[_WorkerStream], report_every: int) -> dict:
         window: list = []
@@ -610,7 +613,13 @@ class PodTrainer:
                 ]
             )
             probs = np.asarray(
-                self.predict_fn(self.state, stack_batches(batches, self.mesh))
+                self.predict_fn(
+                    self.state,
+                    stack_batches(
+                        batches, self.mesh,
+                        compact=self.cfg.data.compact_wire,
+                    ),
+                )
             )
             for d, b in enumerate(group):
                 ps.append(probs[d, : b.num_examples])

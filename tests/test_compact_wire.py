@@ -1,0 +1,161 @@
+"""Compact wire format tests: int32 keys + (B+1,) row_splits must be an
+exact drop-in for int64 keys + (NNZ,) row_ids on every dispatch path.
+
+Reference analog: the reference attacks wire bytes with its filter
+pipeline (src/filter/ key-caching, compression, fixed-point floats); on a
+TPU host feed the same scarce resource is host->device bandwidth and the
+transfer LAYOUT itself is the filter (~40% fewer bytes at typical
+densities)."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data.batch import BatchBuilder, pad_group
+from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
+from parameter_server_tpu.kv.updaters import Ftrl
+from parameter_server_tpu.parallel import (
+    make_mesh,
+    make_spmd_train_multistep,
+    make_spmd_train_step,
+    shard_state,
+    stack_batches,
+    stack_step_groups,
+)
+from parameter_server_tpu.parallel.trainer import PodTrainer
+from parameter_server_tpu.utils.config import PSConfig
+from parameter_server_tpu.utils.metrics import ProgressReporter
+
+NUM_KEYS = 512
+
+
+def quiet():
+    return ProgressReporter(print_fn=lambda *_: None)
+
+
+def _batches(d, n_steps, n_per=64, bucket=False, seed=0):
+    labels, keys, vals, _ = make_sparse_logistic(
+        d * n_steps * n_per, NUM_KEYS - 2, nnz_per_example=8, seed=seed
+    )
+    builder = BatchBuilder(
+        num_keys=NUM_KEYS, batch_size=n_per, max_nnz_per_example=32,
+        key_mode="identity", bucket_nnz=bucket,
+    )
+    out = []
+    for s in range(n_steps):
+        group = []
+        for w in range(d):
+            i = (s * d + w) * n_per
+            group.append(
+                builder.build(
+                    labels[i : i + n_per], keys[i : i + n_per],
+                    vals[i : i + n_per],
+                )
+            )
+        out.append(pad_group(group))
+    return out
+
+
+def test_row_splits_match_row_ids():
+    """The builder's row_splits carry exactly row_ids' information over
+    real entries (including empty rows and the padded tail)."""
+    (group,) = _batches(1, 1, n_per=16)
+    b = group[0]
+    # real entries: row_ids non-decreasing; splits bracket each row
+    for r in range(b.num_examples):
+        lo, hi = b.row_splits[r], b.row_splits[r + 1]
+        np.testing.assert_array_equal(b.row_ids[lo:hi], r)
+    assert b.row_splits[0] == 0
+    assert b.row_splits[b.num_examples] == b.num_entries
+    np.testing.assert_array_equal(
+        b.row_splits[b.num_examples :], b.num_entries
+    )
+
+
+def test_unique_keys_dtype_tracks_key_space():
+    small = BatchBuilder(num_keys=1 << 20, batch_size=4)
+    big = BatchBuilder(num_keys=(1 << 33), batch_size=4, key_mode="identity")
+    labels = np.ones(2, dtype=np.float32)
+    keys = [np.array([3, 5], dtype=np.uint64), np.array([7], dtype=np.uint64)]
+    vals = [np.ones(2, dtype=np.float32), np.ones(1, dtype=np.float32)]
+    assert small.build(labels, keys, vals).unique_keys.dtype == np.int32
+    assert big.build(labels, keys, vals).unique_keys.dtype == np.int64
+
+
+@pytest.mark.parametrize("bucket", [False, True])
+@pytest.mark.parametrize("push_mode", ["per_worker", "aggregate"])
+def test_compact_step_matches_full(push_mode, bucket):
+    d, k = 4, 2
+    up = Ftrl(alpha=0.3, lambda_l1=0.1)
+    mesh = make_mesh(d, k)
+    groups = _batches(d, 4, bucket=bucket)
+    step = make_spmd_train_step(up, mesh, NUM_KEYS, push_mode=push_mode)
+
+    finals = []
+    for compact in (False, True):
+        state = shard_state(up.init(NUM_KEYS, 1), mesh)
+        losses = []
+        for g in groups:
+            state, out = step(state, stack_batches(g, None, compact=compact))
+            losses.append(float(out["loss_sum"]))
+        finals.append((losses, np.asarray(up.weights(state))))
+    np.testing.assert_allclose(finals[0][0], finals[1][0], rtol=1e-6)
+    np.testing.assert_allclose(finals[0][1], finals[1][1], rtol=1e-6, atol=1e-7)
+
+
+def test_compact_multistep_group():
+    """Compact wire composes with K-microstep scanned dispatch (row_splits
+    is fixed-size, so group stacking needs no variable-axis padding)."""
+    d, K = 2, 3
+    up = Ftrl(alpha=0.3, lambda_l1=0.1)
+    mesh = make_mesh(d, 2)
+    groups = _batches(d, K, bucket=True)
+    stepK = make_spmd_train_multistep(up, mesh, NUM_KEYS)
+
+    finals = []
+    for compact in (False, True):
+        state = shard_state(up.init(NUM_KEYS, 1), mesh)
+        items = [stack_batches(g, None, compact=compact) for g in groups]
+        state, out = stepK(state, stack_step_groups(items))
+        finals.append(
+            (np.asarray(out["loss_sum"]), np.asarray(up.weights(state)))
+        )
+    np.testing.assert_allclose(finals[0][0], finals[1][0], rtol=1e-6)
+    np.testing.assert_allclose(finals[0][1], finals[1][1], rtol=1e-6, atol=1e-7)
+
+
+@pytest.fixture(scope="module")
+def files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("compact")
+    labels, keys, vals, _ = make_sparse_logistic(
+        3600, 800, nnz_per_example=10, noise=0.3, seed=13
+    )
+    paths = []
+    for i in range(4):
+        p = d / f"part-{i}.svm"
+        s = slice(i * 900, (i + 1) * 900)
+        write_libsvm(p, labels[s], keys[s], vals[s])
+        paths.append(str(p))
+    return paths
+
+
+def test_pod_trainer_compact_parity(files):
+    """compact_wire on/off trains to identical weights and eval metrics
+    through the full PodTrainer path (pipeline, bucketing, multistep)."""
+    runs = []
+    for compact in (True, False):
+        cfg = PSConfig()
+        cfg.data.num_keys = 1 << 12
+        cfg.data.compact_wire = compact
+        cfg.data.bucket_nnz = True
+        cfg.data.pipeline_depth = 2
+        cfg.solver.minibatch = 128
+        cfg.solver.steps_per_call = 2
+        cfg.penalty.lambda_l1 = 0.05
+        cfg.parallel.data_shards = 4
+        cfg.parallel.kv_shards = 2
+        t = PodTrainer(cfg, reporter=quiet())
+        t.train_files(files, key_mode="identity", report_every=100)
+        ev = t.evaluate_files(files[:1], key_mode="identity")
+        runs.append((t.full_weights(), ev))
+    np.testing.assert_allclose(runs[0][0], runs[1][0], rtol=1e-5, atol=1e-6)
+    assert runs[0][1]["auc"] == pytest.approx(runs[1][1]["auc"], abs=1e-6)
