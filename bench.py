@@ -29,6 +29,8 @@ Extra fields:
                    train), pipelined vs serial ingest.
     word2vec     — fused-SGNS pairs/sec on the device (BASELINE's second
                    parity config), SSP-pipelined dispatch.
+    ingest       — host-side native parse MB/s + parse+localize ex/sec per
+                   stream (bounds e2e on co-located hardware).
 """
 
 from __future__ import annotations
@@ -360,6 +362,42 @@ def bench_pipeline_e2e() -> dict:
     return out
 
 
+def bench_ingest() -> dict:
+    """Host ingest throughput (platform-independent): native parse-only
+    MB/s and parse+build (localize) examples/sec per stream — the numbers
+    that bound e2e on co-located hardware (SURVEY §7.4: the parser must be
+    fast enough to keep chips busy)."""
+    from parameter_server_tpu.data import native
+    from parameter_server_tpu.data.batch import BatchBuilder
+    from parameter_server_tpu.data.reader import MinibatchReader
+    from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
+
+    n = 1 << 17
+    labels, keys, vals, _ = make_sparse_logistic(
+        n, 1 << 16, nnz_per_example=NNZ_PER, noise=0.4, seed=23
+    )
+    out: dict = {"native": native.native_available()}
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "part.svm")
+        write_libsvm(p, labels, keys, vals)
+        sz = os.path.getsize(p)
+        if native.native_available():
+            t0 = time.perf_counter()
+            rows = sum(len(fl[0]) for fl in native.iter_chunks(p, "libsvm"))
+            dt = time.perf_counter() - t0
+            out["parse_mb_per_sec"] = round(sz / dt / 1e6, 1)
+            out["parse_ex_per_sec"] = round(rows / dt, 1)
+        builder = BatchBuilder(
+            num_keys=NUM_KEYS, batch_size=4096, max_nnz_per_example=4 * NNZ_PER
+        )
+        r = MinibatchReader([p], "libsvm", builder)
+        t0 = time.perf_counter()
+        cnt = sum(b.num_examples for b in r)
+        dt = time.perf_counter() - t0
+        out["parse_build_ex_per_sec"] = round(cnt / dt, 1)
+    return out
+
+
 def bench_w2v() -> dict:
     """word2vec SGNS throughput on the device (BASELINE's second parity
     config): two vocab-sized embedding tables, fused SGNS step, pairs/sec
@@ -430,6 +468,7 @@ def main() -> None:
                     "spmd_push": bench_spmd_push(),
                     "pipeline_e2e": bench_pipeline_e2e(),
                     "word2vec": bench_w2v(),
+                    "ingest": bench_ingest(),
                 },
             }
         )

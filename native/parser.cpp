@@ -53,7 +53,7 @@ inline bool parse_hex64(const char*& p, const char* end, uint64_t& out) {
   return true;
 }
 
-inline double parse_float(const char*& p, const char* end) {
+inline double parse_float_slow(const char*& p, const char* end) {
   // strtod needs a NUL-terminated-ish region; lines are short, copy-free use
   // is fine because strtod stops at the first invalid char and the buffer
   // always ends with '\n' (guaranteed by the wrapper).
@@ -61,6 +61,85 @@ inline double parse_float(const char*& p, const char* end) {
   double v = std::strtod(p, &q);
   p = (q && q <= end) ? q : p;
   return v;
+}
+
+inline double parse_float(const char*& p, const char* end) {
+  // Exact fast path for plain decimals (the overwhelming case in ML text
+  // formats): when the collected mantissa fits in 53 bits and the decimal
+  // exponent is within +/-22, one double multiply/divide by an exactly-
+  // representable power of ten is CORRECTLY ROUNDED — bit-identical to
+  // strtod (and hence to the Python parsers). Everything else (inf/nan,
+  // hex floats, 19+ significant digits, big exponents) falls back to
+  // strtod, reparsing from the start so consumption always matches.
+  static const double P10[23] = {
+      1.0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11,
+      1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+  const char* s = p;
+  bool neg = false;
+  if (s < end && (*s == '-' || *s == '+')) {
+    neg = (*s == '-');
+    ++s;
+  }
+  uint64_t mant = 0;
+  int ndig = 0, exp10 = 0;
+  bool any = false, inexact = false;
+  while (s < end && *s >= '0' && *s <= '9') {
+    any = true;
+    if (ndig < 19) {
+      mant = mant * 10 + static_cast<uint64_t>(*s - '0');
+      ++ndig;
+    } else {
+      ++exp10;  // dropped trailing integer digit
+      inexact = true;
+    }
+    ++s;
+  }
+  if (s < end && *s == '.') {
+    ++s;
+    while (s < end && *s >= '0' && *s <= '9') {
+      any = true;
+      if (ndig < 19) {
+        mant = mant * 10 + static_cast<uint64_t>(*s - '0');
+        ++ndig;
+        --exp10;
+      } else {
+        inexact = true;  // dropped fraction digit
+      }
+      ++s;
+    }
+  }
+  if (!any) return parse_float_slow(p, end);  // inf/nan/junk: strtod rules
+  // C99 hex floats ("0x1Ap-3"): the leading 0 scanned as decimal; detect
+  // the x/X and let strtod parse (and consume) the whole literal
+  if (mant == 0 && s < end && (*s == 'x' || *s == 'X'))
+    return parse_float_slow(p, end);
+  if (s < end && (*s == 'e' || *s == 'E')) {
+    const char* es = s + 1;
+    bool eneg = false;
+    if (es < end && (*es == '-' || *es == '+')) {
+      eneg = (*es == '-');
+      ++es;
+    }
+    int ev = 0;
+    bool edig = false;
+    while (es < end && *es >= '0' && *es <= '9' && ev < 10000) {
+      ev = ev * 10 + (*es - '0');
+      edig = true;
+      ++es;
+    }
+    if (edig) {
+      exp10 += eneg ? -ev : ev;
+      s = es;
+    }
+    // 'e' with no digits: the number ends before 'e' (strtod agrees)
+  }
+  if (!inexact && mant < (1ull << 53) && exp10 >= -22 && exp10 <= 22) {
+    double v = static_cast<double>(mant);
+    v = exp10 >= 0 ? v * P10[exp10] : v / P10[-exp10];
+    p = s;
+    return neg ? -v : v;
+  }
+  return parse_float_slow(p, end);
 }
 
 inline void skip_ws(const char*& p, const char* end) {
@@ -219,6 +298,113 @@ int ps_parse_criteo(const char* buf, int64_t len,
   }
   *out_rows = rows;
   *out_nnz = nnz;
+  return 0;
+}
+
+// Hash + localize kernel (ref: src/app/linear_method/localizer.h — remap
+// touched keys to dense local ids; the per-batch hot loop after parsing).
+// Reproduces utils/hashing.hash_keys + np.unique(return_inverse) exactly:
+// splitmix64 with slot salt into [1, num_keys), then SORTED unique keys +
+// 0-based inverse ids. Runs with the GIL released (ctypes), so the
+// prefetch pipeline's builder threads scale across cores — numpy's
+// unique/hash hold the GIL and serialize them.
+//
+// identity != 0 skips hashing: gid = raw + 1 (the exact-parity key mode).
+// Sorting: 2-pass LSD radix over the high 32 bits of (gid<<32 | idx),
+// which requires gid to fit 32 bits (num_keys <= 2^32 — practically
+// always). Return codes: 0 success; -3 identity gid outside
+// [1, num_keys); -4 alloc failure; -5 num_keys > 2^32. On -3/-5 the
+// caller falls back to the numpy path (which owns the error text for -3
+// and handles arbitrarily large key spaces for -5).
+
+static inline uint64_t sm64_mix(uint64_t x) {
+  // identical constants/steps to utils/hashing.splitmix64 (which adds C1
+  // as its first step)
+  uint64_t z = x + 0x9E3779B97F4A7C15ull;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+int ps_hash_localize(const uint64_t* raw, const uint64_t* slots, int64_t n,
+                     uint64_t num_keys, int identity,
+                     int64_t* out_unique, int32_t* out_inverse,
+                     int64_t* out_nuniq) {
+  if (n == 0) {
+    *out_nuniq = 0;
+    return 0;
+  }
+  uint64_t* packed =
+      static_cast<uint64_t*>(std::malloc(2 * sizeof(uint64_t) * n));
+  if (!packed) return -4;
+  uint64_t* alt = packed + n;
+  const uint64_t usable = num_keys - 1;  // hashed gids land in [1, num_keys)
+  const uint64_t C1 = 0x9E3779B97F4A7C15ull;
+  if (identity) {
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t gid = raw[i] + 1;
+      if (gid >= num_keys || gid == 0) {
+        std::free(packed);
+        return -3;
+      }
+      packed[i] = (gid << 32) | static_cast<uint64_t>(i);
+    }
+  } else if (slots) {
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t gid = sm64_mix(raw[i] ^ sm64_mix(slots[i] + C1)) % usable + 1;
+      packed[i] = (gid << 32) | static_cast<uint64_t>(i);
+    }
+  } else {
+    const uint64_t salt0 = sm64_mix(C1);  // slot 0 salt, hoisted
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t gid = sm64_mix(raw[i] ^ salt0) % usable + 1;
+      packed[i] = (gid << 32) | static_cast<uint64_t>(i);
+    }
+  }
+  if (num_keys <= (1ull << 32) && n < (int64_t(1) << 32)) {
+    // stable LSD radix over gid bits only (low idx bits untouched, so
+    // equal gids keep insertion order, like a stable sort). The count
+    // table lives on the heap: builder threads may carry small stacks
+    // (512 KB default pthread stacks on some platforms).
+    int64_t* count =
+        static_cast<int64_t*>(std::malloc(65537 * sizeof(int64_t)));
+    if (!count) {
+      std::free(packed < alt ? packed : alt);
+      return -4;
+    }
+    for (int pass = 0; pass < 2; ++pass) {
+      int shift = 32 + 16 * pass;
+      std::memset(count, 0, 65537 * sizeof(int64_t));
+      for (int64_t i = 0; i < n; ++i)
+        ++count[((packed[i] >> shift) & 0xffff) + 1];
+      for (int b = 0; b < 65536; ++b) count[b + 1] += count[b];
+      for (int64_t i = 0; i < n; ++i)
+        alt[count[(packed[i] >> shift) & 0xffff]++] = packed[i];
+      uint64_t* t = packed;
+      packed = alt;
+      alt = t;
+    }
+    std::free(count);
+  } else {
+    // gid may exceed 32 bits: the (gid<<32 | idx) pack is lossy there
+    std::free(packed);
+    return -5;  // caller falls back to numpy (num_keys > 2^32)
+  }
+  int64_t u = 0;
+  uint64_t prev = ~0ull;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t gid = packed[i] >> 32;
+    uint32_t idx = static_cast<uint32_t>(packed[i]);
+    if (gid != prev) {
+      out_unique[u++] = static_cast<int64_t>(gid);
+      prev = gid;
+    }
+    out_inverse[idx] = static_cast<int32_t>(u - 1);
+  }
+  *out_nuniq = u;
+  // note: `packed` here may be the original malloc block or its second
+  // half; free the block start
+  std::free(packed < alt ? packed : alt);
   return 0;
 }
 

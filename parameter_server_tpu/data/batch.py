@@ -254,24 +254,40 @@ class BatchBuilder:
             nnz = int(keep.sum())
             splits_src = None  # row structure changed; rederive below
 
-        if self.key_mode == "hash":
-            salts = flat_slots if flat_slots is not None else 0
-            gids = hash_keys(flat_keys, self.num_keys, slot_ids=salts)
-        else:
-            gids = np.asarray(flat_keys, dtype=np.int64) + 1
-            if nnz and gids.max() >= self.num_keys:
-                raise ValueError(
-                    f"identity key {gids.max() - 1} >= num_keys-1; "
-                    "grow num_keys or use key_mode='hash'"
-                )
+        # Localizer: unique + inverse, with the pad key forced into slot 0
+        # (ref: localizer.h). The native kernel fuses hash + sort-unique
+        # with the GIL released (builder threads scale across cores); the
+        # numpy path below is the exact-parity fallback.
+        from parameter_server_tpu.data import native as _native
 
-        # Localizer: unique + inverse, with the pad key forced into slot 0.
+        nat = (
+            _native.hash_localize(
+                flat_keys, flat_slots, self.num_keys,
+                identity=self.key_mode != "hash",
+            )
+            if nnz
+            else None
+        )
+        if nat is not None:
+            uniq, inverse = nat
+        else:
+            if self.key_mode == "hash":
+                salts = flat_slots if flat_slots is not None else 0
+                gids = hash_keys(flat_keys, self.num_keys, slot_ids=salts)
+            else:
+                gids = np.asarray(flat_keys, dtype=np.int64) + 1
+                if nnz and gids.max() >= self.num_keys:
+                    raise ValueError(
+                        f"identity key {gids.max() - 1} >= num_keys-1; "
+                        "grow num_keys or use key_mode='hash'"
+                    )
+            uniq, inverse = np.unique(gids, return_inverse=True)
+
         # Keys ride the wire as int32 whenever the key space fits (always,
         # short of a >2^31 dense space) — half the per-unique bytes.
         key_dtype = (
             np.int32 if self.num_keys <= np.iinfo(np.int32).max else np.int64
         )
-        uniq, inverse = np.unique(gids, return_inverse=True)
         uniq = np.concatenate([[PAD_KEY], uniq]).astype(key_dtype)
         inverse = (inverse + 1).astype(np.int32)
         n_uniq = len(uniq)

@@ -82,12 +82,68 @@ def load_native() -> ctypes.CDLL | None:
             u64p, f32p, u64p,  # keys, vals, slots
             i64p, i64p, i64p,  # out_rows, out_nnz, err_line
         ]
+    try:
+        hl = lib.ps_hash_localize
+    except AttributeError:
+        hl = None  # older prebuilt artifact without the kernel
+    if hl is not None:
+        hl.restype = ctypes.c_int
+        hl.argtypes = [
+            u64p, u64p, i64,  # raw keys, slots (or None), n
+            ctypes.c_uint64, ctypes.c_int,  # num_keys, identity flag
+            i64p, ctypes.POINTER(ctypes.c_int32), i64p,  # unique, inverse, n_uniq
+        ]
     _lib = lib
     return _lib
 
 
 def native_available() -> bool:
     return load_native() is not None
+
+
+def hash_localize(
+    raw_keys: np.ndarray,
+    slots: np.ndarray | None,
+    num_keys: int,
+    identity: bool = False,
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """GIL-free hash + localize (ref: the reference's C++ Localizer): hash
+    raw keys into [1, num_keys) (or +1 in identity mode) and return
+    (sorted unique gids int64, 0-based inverse int32) — exactly
+    ``np.unique(hash_keys(...), return_inverse=True)``. Returns None when
+    the kernel is unavailable or inapplicable (no library, num_keys >
+    2^32, identity key out of range) — callers fall back to numpy, which
+    also reproduces the exact error message for the range case."""
+    lib = load_native()
+    if lib is None or not hasattr(lib, "ps_hash_localize"):
+        return None
+    if num_keys < 2:
+        return None  # numpy path owns the clean num_keys>=2 ValueError
+    raw = np.ascontiguousarray(raw_keys, dtype=np.uint64)
+    n = len(raw)
+    unique = np.empty(max(n, 1), dtype=np.int64)
+    inverse = np.empty(max(n, 1), dtype=np.int32)
+    n_uniq = ctypes.c_int64()
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    sl = None
+    if slots is not None:
+        sl = np.ascontiguousarray(slots, dtype=np.uint64)
+    rc = lib.ps_hash_localize(
+        raw.ctypes.data_as(u64p),
+        sl.ctypes.data_as(u64p) if sl is not None else None,
+        n,
+        ctypes.c_uint64(num_keys),
+        1 if identity else 0,
+        unique.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        inverse.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.byref(n_uniq),
+    )
+    if rc == -4:
+        raise MemoryError("ps_hash_localize: allocation failed")
+    if rc != 0:  # -3 identity range error, -5 num_keys > 2^32
+        return None
+    u = n_uniq.value
+    return unique[:u], inverse[:n]
 
 
 def parse_chunk(fmt: str, chunk: bytes, max_rows_hint: int = 0) -> FlatRows:

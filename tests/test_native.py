@@ -269,3 +269,90 @@ class TestReaderNativeBackend:
             for b in MinibatchReader([p], "libsvm", builder, backend="native", epochs=3)
         )
         assert n == 150
+
+
+class TestHashLocalize:
+    """The native hash+localize kernel (ps_hash_localize) must reproduce
+    np.unique(hash_keys(...), return_inverse=True) bit-for-bit — it is the
+    localizer hot loop with the GIL released."""
+
+    def test_matches_numpy_hash_path(self):
+        from parameter_server_tpu.data import native
+        from parameter_server_tpu.utils.hashing import hash_keys
+
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(3)
+        for num_keys in (2, 1 << 10, 1 << 20, (1 << 31) - 7):
+            raw = rng.integers(0, 1 << 62, 20000, dtype=np.uint64)
+            slots = rng.integers(0, 40, 20000, dtype=np.uint64)
+            for sl in (None, slots):
+                got = native.hash_localize(raw, sl, num_keys)
+                assert got is not None
+                ru, ri = np.unique(
+                    hash_keys(raw, num_keys, slot_ids=sl if sl is not None else 0),
+                    return_inverse=True,
+                )
+                np.testing.assert_array_equal(got[0], ru)
+                np.testing.assert_array_equal(got[1], ri)
+
+    def test_identity_mode_and_fallbacks(self):
+        from parameter_server_tpu.data import native
+
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(4)
+        raw = rng.integers(0, 1000, 5000, dtype=np.uint64)
+        got = native.hash_localize(raw, None, 4096, identity=True)
+        ru, ri = np.unique(raw.astype(np.int64) + 1, return_inverse=True)
+        np.testing.assert_array_equal(got[0], ru)
+        np.testing.assert_array_equal(got[1], ri)
+        # out-of-range identity key and >2^32 spaces decline (numpy path
+        # owns those cases, including the exact error message)
+        big = np.array([5000], dtype=np.uint64)
+        assert native.hash_localize(big, None, 4096, identity=True) is None
+        assert native.hash_localize(raw, None, 1 << 33) is None
+
+    def test_float_fast_path_bit_parity(self, tmp_path):
+        """Adversarial float literals through the native parser must be
+        bit-identical to Python float() (the exact-fast-path criterion)."""
+        from parameter_server_tpu.data import native
+
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        vals = [
+            "1", "0.5", "-3.25", "1e5", "2.5E-3", "123456789.123456789",
+            "9007199254740993", "1e-300", "3.14159265358979", "0.1",
+            "-.5", "5.", "1e22", "1e23", "-0.000244140625", "17.125e3",
+            "+4.5", "0.30000000000000004", "2.2250738585072014e-308",
+        ]
+        lines = "\n".join(f"{v} 1:{v}" for v in vals) + "\n"
+        _, _, _, parsed, _ = native.parse_chunk("libsvm", lines.encode())
+        for i, v in enumerate(vals):
+            ref = np.float32(float(v))
+            assert parsed[i].tobytes() == ref.tobytes(), (v, parsed[i], ref)
+
+    def test_num_keys_below_two_raises_not_crashes(self):
+        """num_keys < 2 must surface the numpy path's ValueError, never
+        reach the native kernel (whose modulus would be zero)."""
+        from parameter_server_tpu.data.batch import BatchBuilder
+
+        b = BatchBuilder(num_keys=1, batch_size=4)
+        with pytest.raises(ValueError, match="num_keys must be >= 2"):
+            b.build(
+                np.ones(1, np.float32),
+                [np.array([3], np.uint64)],
+                [np.ones(1, np.float32)],
+            )
+
+    def test_hex_floats_fall_back_to_strtod(self):
+        from parameter_server_tpu.data import native
+
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        _, _, _, vals, _ = native.parse_chunk(
+            "libsvm", b"1 1:0x1A 2:0x1p-3 3:0.5\n"
+        )
+        np.testing.assert_array_equal(
+            vals[:3], np.array([26.0, 0.125, 0.5], dtype=np.float32)
+        )
