@@ -93,17 +93,28 @@ def _mlp_update(opt, g_mlp, opt_state, mlp_params):
     return optax.apply_updates(mlp_params, updates), new_opt_state
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(3, 4))
-def wd_train_step(
+def _gated_mlp_update(opt, g_mlp, opt_state, mlp_params, act):
+    """MLP/optimizer step applied only when ``act`` (bool scalar) is true;
+    an inert step returns params and optimizer state unchanged."""
+    new_mlp, new_opt = _mlp_update(opt, g_mlp, opt_state, mlp_params)
+    gate = lambda new, old: jax.tree.map(  # noqa: E731
+        lambda n, o: jnp.where(act, n, o), new, old
+    )
+    return gate(new_mlp, mlp_params), gate(new_opt, opt_state)
+
+
+def _wd_micro(
     wide_up: Updater,
     emb_up: Updater,
-    opt: Any,  # optax optimizer (static: hashable namedtuple of fns? no — see make)
+    opt: Any,
     wide_state: State,
     emb_state: State,
     mlp_params: Any,
     opt_state: Any,
     batch: dict[str, jax.Array],
 ):
+    """One single-device Wide&Deep step — shared verbatim by the per-step
+    jit and the scanned multistep program."""
     idx = batch["unique_keys"]
     wide_rows = {k: jnp.take(v, idx, axis=0) for k, v in wide_state.items()}
     emb_rows = {k: jnp.take(v, idx, axis=0) for k, v in emb_state.items()}
@@ -117,30 +128,70 @@ def wd_train_step(
     d_emb = emb_up.delta(emb_rows, g_emb)
     new_emb = {k: emb_state[k].at[idx].add(d_emb[k]) for k in emb_state}
 
-    new_mlp, new_opt_state = _mlp_update(opt, g_mlp, opt_state, mlp_params)
+    # an all-masked (inert) batch must be a true no-op: unlike the KV
+    # updaters (zero grad => zero delta), Adam still advances its moment
+    # decay on a zero gradient, so the MLP update is gated on activity
+    # (multistep pads partial groups with inert microsteps)
+    act = jnp.any(batch["example_mask"])
+    new_mlp, new_opt_state = _gated_mlp_update(
+        opt, g_mlp, opt_state, mlp_params, act
+    )
     probs = jax.nn.sigmoid(logits)
     return new_wide, new_emb, new_mlp, new_opt_state, loss, probs
 
 
-def make_wd_spmd_train_step(
+@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(3, 4))
+def wd_train_step(
+    wide_up: Updater,
+    emb_up: Updater,
+    opt: Any,  # optax optimizer (static: hashable namedtuple of fns? no — see make)
+    wide_state: State,
+    emb_state: State,
+    mlp_params: Any,
+    opt_state: Any,
+    batch: dict[str, jax.Array],
+):
+    return _wd_micro(
+        wide_up, emb_up, opt, wide_state, emb_state, mlp_params, opt_state,
+        batch,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(3, 4))
+def wd_train_multistep(
+    wide_up: Updater,
+    emb_up: Updater,
+    opt: Any,
+    wide_state: State,
+    emb_state: State,
+    mlp_params: Any,
+    opt_state: Any,
+    batch: dict[str, jax.Array],  # fields carry a leading (K_steps, ...) axis
+):
+    """K sequential Wide&Deep steps scanned on-device in one dispatch (the
+    steps_per_call idiom; see parallel.spmd.make_spmd_train_multistep).
+    Returns per-microstep losses (K,) and probs (K, B)."""
+
+    def body(carry, mb):
+        new = _wd_micro(wide_up, emb_up, opt, *carry, mb)
+        return tuple(new[:4]), (new[4], new[5])
+
+    carry = (wide_state, emb_state, mlp_params, opt_state)
+    (w, e, m, o), (losses, probs) = jax.lax.scan(body, carry, batch)
+    return w, e, m, o, losses, probs
+
+
+def _make_wd_spmd(
     wide_up: Updater,
     emb_up: Updater,
     opt: Any,
     mesh,
     num_keys: int,
-    push_mode: str = "per_worker",
+    push_mode: str,
+    multistep: bool,
 ):
-    """Multi-device Wide&Deep step: both KV tables range-sharded over the
-    ``kv`` mesh axis (BASELINE.json: "server-sharded embeddings"), batches
-    over ``data``; MLP params replicated with psum'd gradients.
-
-    Same wire pattern as the linear SPMD step (parallel/spmd.py): pull =
-    masked gather + psum over kv; push = all_gather over data + sequential
-    per-worker updates on each kv shard — or, with push_mode "aggregate",
-    one psum per table pre-sums the per-key grads and ONE updater step
-    applies them (parallel/spmd._local_push_aggregate; the embedding-table
-    push is this app's dominant traffic)."""
-
+    """Shared builder for the K=1 and scanned-K Wide&Deep mesh programs
+    (one home for validation, specs, and the jit contract)."""
     from jax import lax, shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -157,8 +208,7 @@ def make_wd_spmd_train_step(
         raise ValueError(f"unknown push_mode {push_mode!r}")
     shard_size = _shard_size(num_keys, mesh.shape["kv"])
 
-    def local_step(wide_l, emb_l, mlp_params, opt_state, batch):
-        b = {k: v[0] for k, v in batch.items()}
+    def micro(wide_l, emb_l, mlp_params, opt_state, b):
         idx = b["unique_keys"]
         w_u = lax.psum(_local_pull(wide_up, wide_l, idx, shard_size), "kv")
         e_u = lax.psum(_local_pull(emb_up, emb_l, idx, shard_size), "kv")
@@ -183,10 +233,29 @@ def make_wd_spmd_train_step(
                 shard_size,
             )
         g_mlp = jax.tree.map(lambda g: lax.psum(g, "data"), g_mlp)
-        new_mlp, new_opt_state = _mlp_update(opt, g_mlp, opt_state, mlp_params)
+        # gate on POD-WIDE activity (any shard's real examples): a fully
+        # inert microstep must not advance Adam's moment decay
+        act = lax.psum(jnp.sum(b["example_mask"]), "data") > 0
+        new_mlp, new_opt_state = _gated_mlp_update(
+            opt, g_mlp, opt_state, mlp_params, act
+        )
         loss_sum = lax.psum(loss, "data")
-        probs = jax.nn.sigmoid(logits)[None, :]
+        probs = jax.nn.sigmoid(logits)
         return new_wide, new_emb, new_mlp, new_opt_state, loss_sum, probs
+
+    def local_step(wide_l, emb_l, mlp_params, opt_state, batch):
+        b = {k: v[0] for k, v in batch.items()}
+        if not multistep:
+            out = micro(wide_l, emb_l, mlp_params, opt_state, b)
+            return (*out[:5], out[5][None, :])  # probs -> (D, B)
+
+        def body(carry, mb):  # b fields carry a leading (K_steps, ...) axis
+            out = micro(*carry, mb)
+            return tuple(out[:4]), (out[4], out[5])
+
+        carry = (wide_l, emb_l, mlp_params, opt_state)
+        (w, e, m, o), (losses, probs) = lax.scan(body, carry, b)
+        return w, e, m, o, losses, probs[None]  # probs -> (D, K, B)
 
     step = shard_map(
         local_step,
@@ -203,6 +272,62 @@ def make_wd_spmd_train_step(
     return jitted
 
 
+def make_wd_spmd_train_step(
+    wide_up: Updater,
+    emb_up: Updater,
+    opt: Any,
+    mesh,
+    num_keys: int,
+    push_mode: str = "per_worker",
+):
+    """Multi-device Wide&Deep step: both KV tables range-sharded over the
+    ``kv`` mesh axis (BASELINE.json: "server-sharded embeddings"), batches
+    over ``data``; MLP params replicated with psum'd gradients.
+
+    Same wire pattern as the linear SPMD step (parallel/spmd.py): pull =
+    masked gather + psum over kv; push = all_gather over data + sequential
+    per-worker updates on each kv shard — or, with push_mode "aggregate",
+    one psum per table pre-sums the per-key grads and ONE updater step
+    applies them (parallel/spmd._local_push_aggregate; the embedding-table
+    push is this app's dominant traffic)."""
+    return _make_wd_spmd(
+        wide_up, emb_up, opt, mesh, num_keys, push_mode, multistep=False
+    )
+
+
+def make_wd_spmd_train_multistep(
+    wide_up: Updater,
+    emb_up: Updater,
+    opt: Any,
+    mesh,
+    num_keys: int,
+    push_mode: str = "per_worker",
+):
+    """K sequential Wide&Deep steps per device call over the (data, kv)
+    mesh: batch fields stacked (D, K_steps, ...). Returns per-microstep
+    losses (K,) and probs (D, K, B)."""
+    return _make_wd_spmd(
+        wide_up, emb_up, opt, mesh, num_keys, push_mode, multistep=True
+    )
+
+
+def _inert_like(b: CSRBatch) -> CSRBatch:
+    """All-zero batch with b's static shapes (mask False, value 0): the
+    pad for a partial multistep group — zero loss, zero gradient."""
+    return CSRBatch(
+        unique_keys=np.zeros_like(b.unique_keys),
+        local_ids=np.zeros_like(b.local_ids),
+        row_ids=np.zeros_like(b.row_ids),
+        values=np.zeros_like(b.values),
+        labels=np.zeros_like(b.labels),
+        example_mask=np.zeros_like(b.example_mask),
+        row_splits=np.zeros_like(b.row_splits),
+        num_examples=0,
+        num_unique=1,
+        num_entries=0,
+    )
+
+
 class WideDeep:
     """The Wide&Deep app: shared hashed key space for wide + embedding."""
 
@@ -216,9 +341,17 @@ class WideDeep:
         mlp_lr: float = 1e-3,
         seed: int = 0,
         reporter: ProgressReporter | None = None,
+        steps_per_call: int = 1,
     ):
         self.num_keys = num_keys
         self.reporter = reporter or ProgressReporter()
+        # K sequential W&D steps scanned per device call (the
+        # solver.steps_per_call idiom; see parallel.spmd): amortizes the
+        # per-call host<->device round-trip floor. report_every then
+        # counts device calls.
+        if steps_per_call < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+        self.steps_per_call = steps_per_call
         self.wide_up = Ftrl(**(ftrl_kw or {"alpha": 0.1, "lambda_l1": 0.5}))
         self.emb_up = Adagrad(eta=emb_eta)
         self.wide_state = self.wide_up.init(num_keys, 1)
@@ -233,35 +366,66 @@ class WideDeep:
         self.examples_seen = 0
 
     def train(self, batches: Iterable[CSRBatch], report_every: int = 100) -> dict:
+        """Train over a CSRBatch stream. With steps_per_call = K > 1,
+        groups of K batches are padded to one static shape and scanned in
+        a single device call (report_every counts device calls)."""
         window_p, window_y, losses = [], [], []
         n_since = 0
         t0 = time.perf_counter()
         last: dict = {}
-        for i, b in enumerate(batches):
-            dev = batch_to_device(b)
-            (
-                self.wide_state,
-                self.emb_state,
-                self.mlp_params,
-                self.opt_state,
-                loss,
-                probs,
-            ) = wd_train_step(
-                self.wide_up,
-                self.emb_up,
-                self.opt,
-                self.wide_state,
-                self.emb_state,
-                self.mlp_params,
-                self.opt_state,
-                dev,
-            )
-            self.examples_seen += b.num_examples
-            n_since += b.num_examples
-            losses.append(loss)
-            window_p.append((probs, b.num_examples))
-            window_y.append(b.labels[: b.num_examples])
-            if (i + 1) % report_every == 0:
+        K = self.steps_per_call
+        it = iter(batches)
+        call_i = 0
+        while True:
+            group = []
+            for _ in range(K):
+                b = next(it, None)
+                if b is None:
+                    break
+                group.append(b)
+            if not group:
+                break
+            if K == 1:
+                (
+                    self.wide_state, self.emb_state, self.mlp_params,
+                    self.opt_state, loss, probs,
+                ) = wd_train_step(
+                    self.wide_up, self.emb_up, self.opt,
+                    self.wide_state, self.emb_state, self.mlp_params,
+                    self.opt_state, batch_to_device(group[0]),
+                )
+                losses.append(loss)
+                window_p.append((probs, group[0].num_examples))
+                window_y.append(group[0].labels[: group[0].num_examples])
+            else:
+                from parameter_server_tpu.data.batch import pad_group
+                from parameter_server_tpu.parallel.spmd import (
+                    CSR_FULL_FIELDS,
+                    stack_fields,
+                )
+
+                padded = pad_group(group + [
+                    _inert_like(group[0]) for _ in range(K - len(group))
+                ])
+                stacked = stack_fields(padded, CSR_FULL_FIELDS, None)
+                dev = {k: jnp.asarray(v) for k, v in stacked.items()}
+                (
+                    self.wide_state, self.emb_state, self.mlp_params,
+                    self.opt_state, loss_k, probs_k,
+                ) = wd_train_multistep(
+                    self.wide_up, self.emb_up, self.opt,
+                    self.wide_state, self.emb_state, self.mlp_params,
+                    self.opt_state, dev,
+                )
+                losses.append(loss_k)  # (K,) — _flush sums arrays too
+                for k, b in enumerate(group):
+                    window_p.append((probs_k[k], b.num_examples))
+                    window_y.append(b.labels[: b.num_examples])
+            n_group = sum(b.num_examples for b in group)
+            self.examples_seen += n_group
+            n_since += n_group
+            call_i += 1
+            if call_i % report_every == 0:
                 last = self._flush(losses, window_p, window_y, n_since, t0)
                 losses, window_p, window_y = [], [], []
                 n_since, t0 = 0, time.perf_counter()
@@ -270,7 +434,12 @@ class WideDeep:
         return last
 
     def _flush(self, losses, window_p, window_y, n_since, t0):
-        loss_sum = float(sum(float(x) for x in jax.device_get(losses)))
+        loss_sum = float(
+            sum(
+                float(np.sum(np.asarray(x)))
+                for x in jax.device_get(losses)
+            )
+        )
         p = np.concatenate([np.asarray(pr)[:n] for pr, n in window_p])
         y = np.concatenate(window_y)
         return self.reporter.report(

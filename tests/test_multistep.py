@@ -271,6 +271,97 @@ class TestMatrixFacMultistep:
         assert finals[0][0][-1] < finals[0][0][0]  # it actually learns
 
 
+class TestWideDeepMultistep:
+    def _batches(self, n_batches=7, n_per=64):
+        labels, keys, vals, _ = make_sparse_logistic(
+            n_batches * n_per, 60, nnz_per_example=6, noise=0.3, seed=9
+        )
+        builder = BatchBuilder(
+            num_keys=64, batch_size=n_per, max_nnz_per_example=16,
+            key_mode="identity",
+        )
+        return [
+            builder.build(
+                labels[i : i + n_per], keys[i : i + n_per], vals[i : i + n_per]
+            )
+            for i in range(0, n_batches * n_per, n_per)
+        ]
+
+    def test_wd_multistep_matches_single_step(self):
+        """steps_per_call=3 over 7 batches (tail group padded with inert
+        microsteps, which must not advance Adam's moment decay) reproduces
+        the K=1 trajectory exactly."""
+        from parameter_server_tpu.models.wide_deep import WideDeep
+
+        batches = self._batches()
+        outs = []
+        for k in (1, 3):
+            wd = WideDeep(
+                num_keys=64, emb_dim=8, hidden=[16], mlp_lr=5e-3, seed=0,
+                reporter=quiet(), steps_per_call=k,
+            )
+            last = wd.train(batches, report_every=100)
+            p, y = wd.predict(batches[:2])
+            outs.append((last, p))
+        assert outs[0][0]["objv"] == pytest.approx(outs[1][0]["objv"], rel=1e-5)
+        np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-4, atol=1e-6)
+        assert outs[0][0]["auc"] == pytest.approx(outs[1][0]["auc"], abs=1e-6)
+
+    def test_wd_spmd_multistep_matches_single_step(self):
+        """The mesh multistep program matches K sequential mesh steps."""
+        from parameter_server_tpu.models.wide_deep import (
+            WideDeep,
+            make_wd_spmd_train_step,
+            make_wd_spmd_train_multistep,
+        )
+        from parameter_server_tpu.parallel.spmd import (
+            CSR_FULL_FIELDS,
+            shard_state,
+            stack_fields,
+        )
+
+        d, K = 2, 3
+        mesh = make_mesh(d, 2)
+        batches = self._batches(n_batches=d * K)
+        groups = [
+            stack_fields(batches[s * d : (s + 1) * d], CSR_FULL_FIELDS, None)
+            for s in range(K)
+        ]
+
+        outs = []
+        for multi in (False, True):
+            app = WideDeep(
+                num_keys=64, emb_dim=8, hidden=[16], mlp_lr=5e-3, seed=0,
+                reporter=quiet(),
+            )
+            wide = shard_state(app.wide_state, mesh)
+            emb = shard_state(app.emb_state, mesh)
+            mlp, opt_state = app.mlp_params, app.opt_state
+            if multi:
+                stepK = make_wd_spmd_train_multistep(
+                    app.wide_up, app.emb_up, app.opt, mesh, 64
+                )
+                grouped = stack_step_groups(groups)
+                wide, emb, mlp, opt_state, losses, probs = stepK(
+                    wide, emb, mlp, opt_state, grouped
+                )
+                losses = [float(x) for x in np.asarray(losses)]
+                assert probs.shape[:2] == (d, K)
+            else:
+                step1 = make_wd_spmd_train_step(
+                    app.wide_up, app.emb_up, app.opt, mesh, 64
+                )
+                losses = []
+                for g in groups:
+                    wide, emb, mlp, opt_state, loss, _ = step1(
+                        wide, emb, mlp, opt_state, g
+                    )
+                    losses.append(float(loss))
+            outs.append((losses, np.asarray(app.wide_up.weights(wide))))
+        np.testing.assert_allclose(outs[0][0], outs[1][0], rtol=1e-5)
+        np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-4, atol=1e-6)
+
+
 class TestPodTrainerMultistepOverlap:
     @pytest.mark.parametrize("max_delay", [0, 2])
     def test_multistep_with_dispatch_overlap(self, files, max_delay):
