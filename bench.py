@@ -333,10 +333,14 @@ def bench_pipeline_e2e() -> dict:
             write_libsvm(p, labels[s], keys[s], vals[s])
             paths.append(p)
         out["bucket_nnz"] = True
-        # pipelined_k8: the scanned multistep path (steps_per_call=8) on
-        # top of the threaded pipeline — one transfer/dispatch per 8 steps
-        for depth, k, label in (
-            (2, 8, "pipelined_k8"), (2, 1, "pipelined"), (0, 1, "serial"),
+        # pipelined_k8: the production fast path — scanned multistep
+        # (steps_per_call=8) + SSP run-ahead (max_delay=2, overlapping
+        # transfer with compute) on top of the threaded pipeline, compact
+        # wire. pipelined/serial stay at K=1/delay=0 to isolate the
+        # thread-pipeline contrast.
+        for depth, k, delay, label in (
+            (2, 8, 2, "pipelined_k8"), (2, 1, 0, "pipelined"),
+            (0, 1, 0, "serial"),
         ):
             cfg = PSConfig()
             cfg.data.num_keys = NUM_KEYS
@@ -348,6 +352,7 @@ def bench_pipeline_e2e() -> dict:
             cfg.data.max_nnz_per_example = 4 * NNZ_PER
             cfg.solver.minibatch = 4096
             cfg.solver.steps_per_call = k
+            cfg.solver.max_delay = delay
             cfg.penalty.lambda_l1 = L1
             t = PodTrainer(cfg, reporter=ProgressReporter(print_fn=lambda *_: None))
             t.train_files(paths[:1], report_every=1000)  # compile warmup

@@ -168,6 +168,63 @@ class Runtime:
         host_state, meta = load_checkpoint(ckpt_dir)
         return self.state_from_host(host_state), meta
 
+    def cp_allmax(
+        self, tag: str, values: tuple[int, ...], timeout_ms: int = 600_000
+    ) -> tuple[int, ...] | None:
+        """Control-plane elementwise max across processes via the
+        coordination-service KV store — NO device collective, so the
+        dispatch thread never syncs to the device stream and async
+        run-ahead (SSP max_delay) survives. This is the bucket-agreement
+        fast path; ``None`` means no distributed client is wired
+        (single-process runs, or a runtime built without
+        jax.distributed) and the caller should fall back to a device
+        allgather.
+
+        ``tag`` must be unique per reduction pod-wide and issued in the
+        same order on every process (the trainer uses "<epoch-gen>/<step>").
+        Designated-reducer shape: every process posts its values; process
+        0 reads all P posts and publishes the max; followers do ONE
+        blocking get on the published key — O(1) RPCs per follower per
+        step, so the control-plane cost does not grow with the pod on the
+        dispatch critical path (process 0 pays O(P), off-device).
+
+        Cleanup: each process deletes its own post (and 0 the published
+        max) from two tags back — by the time any process starts
+        reduction t, every process completed t-1, which required the
+        published max of t-1, which required every post of t-1; so t-2
+        keys are dead. The final two tags of a sequence leak a few tiny
+        strings (reclaimed when the coordinator exits)."""
+        if self.process_count == 1:
+            return tuple(int(v) for v in values)
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            return None
+        me = self.process_index
+        enc = ",".join(str(int(v)) for v in values)
+        gen, _, step = tag.rpartition("/")
+        dead = f"{gen}/{int(step) - 2}" if step.isdigit() and int(step) >= 2 else None
+        if me == 0:
+            out = [int(v) for v in values]
+            for p in range(1, self.process_count):
+                got = client.blocking_key_value_get(
+                    f"psbkt/{tag}/{p}", timeout_ms
+                )
+                for i, v in enumerate(got.split(",")):
+                    out[i] = max(out[i], int(v))
+            client.key_value_set(
+                f"psbkt/{tag}/max", ",".join(str(v) for v in out)
+            )
+            if dead is not None:
+                client.key_value_delete(f"psbkt/{dead}/max")
+            return tuple(out)
+        client.key_value_set(f"psbkt/{tag}/{me}", enc)
+        got = client.blocking_key_value_get(f"psbkt/{tag}/max", timeout_ms)
+        if dead is not None:
+            client.key_value_delete(f"psbkt/{dead}/{me}")
+        return tuple(int(v) for v in got.split(","))
+
     def barrier(self, name: str = "") -> None:
         if self.process_count == 1:
             return

@@ -18,6 +18,7 @@ host batch-prep with device compute."""
 
 from __future__ import annotations
 
+import itertools
 import time
 from collections.abc import Iterator
 
@@ -42,6 +43,11 @@ from parameter_server_tpu.parallel.ssp import DispatchWindow, SSPClock
 from parameter_server_tpu.parallel.workload import WorkloadPool
 from parameter_server_tpu.utils.config import PSConfig
 from parameter_server_tpu.utils.metrics import ProgressReporter
+
+
+# process-wide trainer sequence for control-plane KV namespacing (see
+# PodTrainer._bucket_ns)
+_TRAINER_SEQ = itertools.count()
 
 
 class _WorkerStream:
@@ -156,17 +162,31 @@ class PodTrainer:
         # multi-host bucketing: shapes are sized per host, but SPMD demands
         # identical shapes (and programs) on every process per step — a
         # tiny per-step cross-host max-agreement re-pads every host to the
-        # pod max bucket (see _agree_bucket)
+        # pod max bucket (see _agree_bucket). The agreement rides the
+        # coordination-service KV (control plane) when available, which
+        # keeps SSP run-ahead alive; the device-allgather fallback caps
+        # run-ahead at 1 because it syncs the dispatch thread to the
+        # device stream.
         self._bucket_sync = (
             cfg.data.bucket_nnz and self.runtime.process_count > 1
         )
+        # KV-key namespacing: trainers are constructed in the same order
+        # on every process (the SPMD same-program contract), so a
+        # process-wide counter yields pod-agreed, collision-free
+        # namespaces; epochs within a trainer get their own sub-counter
+        self._bucket_ns = f"t{next(_TRAINER_SEQ)}"
+        self._epoch_seq = itertools.count()
         if self._bucket_sync and cfg.solver.max_delay > 0:
-            print(
-                "[pod] note: multi-host bucket_nnz agreement caps dispatch "
-                "run-ahead at 1 (see PodTrainer._agree_bucket); max_delay "
-                f"{cfg.solver.max_delay} will not add overlap",
-                flush=True,
-            )
+            probe = self.runtime.cp_allmax(f"{self._bucket_ns}probe/0", (0,))
+            if probe is None:
+                print(
+                    "[pod] note: no control-plane KV — multi-host "
+                    "bucket_nnz agreement falls back to a device "
+                    "allgather, capping dispatch run-ahead at 1; "
+                    f"max_delay {cfg.solver.max_delay} will not add "
+                    "overlap",
+                    flush=True,
+                )
         self.data_shards = self.mesh.shape["data"]
         # this process feeds only its own data rows (multi-host contract)
         self.local_data_shards = self.runtime.local_data_shards
@@ -346,34 +366,38 @@ class PodTrainer:
         counts = [b.num_examples for b in batches]
         return stacked, n, labels, counts
 
-    def _agree_bucket(self, stacked: dict) -> dict:
-        """Pod-wide bucket agreement for bucketed batches: allgather every
-        host's local (nnz, unique) shape, take the max, and zero-pad up to
-        it. Buckets are powers of two, so the agreed set of shapes (and
+    def _agree_bucket(self, stacked: dict, tag: str) -> dict:
+        """Pod-wide bucket agreement for bucketed batches: max-reduce
+        every host's local (nnz, unique) shape and zero-pad up to the pod
+        max. Buckets are powers of two, so the agreed set of shapes (and
         compiled programs) stays small pod-wide.
 
-        COST (documented tradeoff): the agreement is a device collective
-        and this thread blocks on its result, which also waits for the
-        previously dispatched step — multi-host bucketed runs therefore
-        cap the SSP/async run-ahead at 1 regardless of max_delay. Worth it
-        when host->device bytes dominate (the bucketing win), not when
-        overlap does; a host-side control-plane reduce (coordinator KV)
-        would lift the cap and is the designed upgrade path."""
-        from jax.experimental import multihost_utils
-
+        The reduce rides the coordination-service KV (Runtime.cp_allmax)
+        — pure control plane, so the dispatch thread keeps its SSP
+        run-ahead. Fallback (no distributed client): a device allgather,
+        which blocks this thread on the device stream and caps run-ahead
+        at 1 regardless of max_delay (warned at init)."""
         from parameter_server_tpu.data.batch import zero_extend
 
         # trailing axis is the variable one for both single-step (D, NNZ)
         # and multistep-group (D, K, NNZ) stacks
-        local = np.array(
-            [stacked["values"].shape[-1], stacked["unique_keys"].shape[-1]],
-            dtype=np.int32,
+        local = (
+            stacked["values"].shape[-1], stacked["unique_keys"].shape[-1],
         )
-        nnz_t, u_t = (
-            np.asarray(multihost_utils.process_allgather(local))
-            .reshape(-1, 2)
-            .max(axis=0)
-        )
+        agreed = self.runtime.cp_allmax(tag, local)
+        if agreed is None:
+            from jax.experimental import multihost_utils
+
+            agreed = (
+                np.asarray(
+                    multihost_utils.process_allgather(
+                        np.array(local, dtype=np.int32)
+                    )
+                )
+                .reshape(-1, 2)
+                .max(axis=0)
+            )
+        nnz_t, u_t = agreed
         out = {
             **stacked,
             "unique_keys": zero_extend(stacked["unique_keys"], int(u_t), axis=-1),
@@ -391,6 +415,8 @@ class PodTrainer:
         step_idx = 0
         last: dict = {}
         drained = False  # a retired step reported 0 pod-wide examples
+        # per-epoch control-plane KV namespace (pod-agreed; see _bucket_ns)
+        bkt_gen = f"{self._bucket_ns}e{next(self._epoch_seq)}"
 
         def _retire(step: int, entry) -> None:
             nonlocal drained
@@ -494,7 +520,9 @@ class PodTrainer:
                 else:
                     stacked_np, n, metas = _next_item()
                 if self._bucket_sync:
-                    stacked_np = self._agree_bucket(stacked_np)
+                    stacked_np = self._agree_bucket(
+                        stacked_np, f"{bkt_gen}/{step_idx}"
+                    )
                 stacked = self.runtime.globalize_batch(stacked_np)
                 # push_seed varies per microstep so quantized-push
                 # stochastic rounding never reuses a key (traced scalar:

@@ -6,6 +6,7 @@ tasks (SURVEY §2.9 SSP / §3.3 DARLIN's block pipeline) — on TPU the
 pipelining moves INTO the compiled program as a lax.scan so dispatch and
 host<->device round trips are paid once per K steps, not per step."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -308,9 +309,13 @@ class TestWideDeepMultistep:
         assert outs[0][0]["auc"] == pytest.approx(outs[1][0]["auc"], abs=1e-6)
 
     def test_wd_spmd_multistep_matches_single_step(self):
-        """The mesh multistep program matches K sequential mesh steps."""
+        """The mesh multistep program matches K-1 sequential mesh steps
+        when the K-th microstep is all-inert (the padded-tail case): the
+        pod-wide activity gate must keep Adam's moments AND count frozen
+        on the pad, or mlp/opt state silently diverges."""
         from parameter_server_tpu.models.wide_deep import (
             WideDeep,
+            _inert_like,
             make_wd_spmd_train_step,
             make_wd_spmd_train_multistep,
         )
@@ -322,11 +327,14 @@ class TestWideDeepMultistep:
 
         d, K = 2, 3
         mesh = make_mesh(d, 2)
-        batches = self._batches(n_batches=d * K)
+        batches = self._batches(n_batches=d * (K - 1))
         groups = [
             stack_fields(batches[s * d : (s + 1) * d], CSR_FULL_FIELDS, None)
-            for s in range(K)
+            for s in range(K - 1)
         ]
+        inert = stack_fields(
+            [_inert_like(batches[0]) for _ in range(d)], CSR_FULL_FIELDS, None
+        )
 
         outs = []
         for multi in (False, True):
@@ -341,11 +349,13 @@ class TestWideDeepMultistep:
                 stepK = make_wd_spmd_train_multistep(
                     app.wide_up, app.emb_up, app.opt, mesh, 64
                 )
-                grouped = stack_step_groups(groups)
+                grouped = stack_step_groups(groups + [inert])
                 wide, emb, mlp, opt_state, losses, probs = stepK(
                     wide, emb, mlp, opt_state, grouped
                 )
                 losses = [float(x) for x in np.asarray(losses)]
+                assert losses[-1] == 0.0  # the inert microstep
+                losses = losses[:-1]
                 assert probs.shape[:2] == (d, K)
             else:
                 step1 = make_wd_spmd_train_step(
@@ -357,9 +367,20 @@ class TestWideDeepMultistep:
                         wide, emb, mlp, opt_state, g
                     )
                     losses.append(float(loss))
-            outs.append((losses, np.asarray(app.wide_up.weights(wide))))
+            outs.append(
+                (
+                    losses,
+                    np.asarray(app.wide_up.weights(wide)),
+                    jax.tree.leaves((mlp, opt_state)),
+                )
+            )
         np.testing.assert_allclose(outs[0][0], outs[1][0], rtol=1e-5)
         np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-4, atol=1e-6)
+        # MLP params and full Adam state (count included) agree leaf-wise
+        for a, b in zip(outs[0][2], outs[1][2]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
 
 
 class TestPodTrainerMultistepOverlap:
