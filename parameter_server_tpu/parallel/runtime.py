@@ -175,10 +175,10 @@ class Runtime:
         coordination-service KV store — NO device collective, so the
         dispatch thread never syncs to the device stream and async
         run-ahead (SSP max_delay) survives. This is the bucket-agreement
-        fast path; ``None`` means no distributed client is wired
-        (single-process runs, or a runtime built without
-        jax.distributed) and the caller should fall back to a device
-        allgather.
+        fast path. Single-process runtimes short-circuit to the local
+        values; ``None`` means a MULTI-process runtime has no distributed
+        client wired (built without jax.distributed) and the caller
+        should fall back to a device allgather.
 
         ``tag`` must be unique per reduction pod-wide and issued in the
         same order on every process (the trainer uses "<epoch-gen>/<step>").
