@@ -14,6 +14,8 @@
 //    the 0-based index of the offending line in the chunk).
 //  - Key hashing stays on the numpy side (utils.hashing) so Python and C++
 //    ingest agree bit-for-bit by construction.
+//  - ``slots`` may be NULL for slot-free formats (libsvm): the parser then
+//    skips the per-entry zero store and the caller skips the buffer.
 
 #include <cctype>
 #include <cmath>
@@ -23,11 +25,15 @@
 
 namespace {
 
-// fast positive-integer / hex parse; returns false on junk
+// fast positive-integer / hex parse; returns false on junk.
+// (plain range compares, not std::isdigit: the locale-aware function
+// call is a measurable cost in the per-entry hot loop)
+inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
+
 inline bool parse_u64(const char*& p, const char* end, uint64_t& out) {
-  if (p >= end || !std::isdigit(static_cast<unsigned char>(*p))) return false;
+  if (p >= end || !is_digit(*p)) return false;
   uint64_t v = 0;
-  while (p < end && std::isdigit(static_cast<unsigned char>(*p))) {
+  while (p < end && is_digit(*p)) {
     v = v * 10 + static_cast<uint64_t>(*p - '0');
     ++p;
   }
@@ -148,20 +154,29 @@ inline void skip_ws(const char*& p, const char* end) {
 
 // Line end for [p, buf_end): first '\n', '\r', or '\r\n' terminator (or
 // buf_end), universal-newlines style, so CRLF and lone-CR files parse like
-// the Python text-mode readers.
+// the Python text-mode readers. ``any_cr`` is a chunk-level hint computed
+// ONCE (one memchr over the chunk): the overwhelmingly common LF-only
+// file skips the per-line '\r' scan — a second full pass over every
+// line's bytes otherwise.
 inline const char* find_line_end(const char* p, const char* end,
-                                 const char** next_line) {
+                                 const char** next_line, bool any_cr) {
   const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-  // search '\r' only up to nl: scanning to end on every LF-only line would
-  // make parsing quadratic in the chunk size
-  const char* cr_stop = nl ? nl : end;
-  const char* cr = static_cast<const char*>(memchr(p, '\r', cr_stop - p));
-  if (cr) {
-    *next_line = (cr + 1 < end && cr[1] == '\n') ? cr + 2 : cr + 1;
-    return cr;
+  if (any_cr) {
+    // search '\r' only up to nl: scanning to end on every LF-only line
+    // would make parsing quadratic in the chunk size
+    const char* cr_stop = nl ? nl : end;
+    const char* cr = static_cast<const char*>(memchr(p, '\r', cr_stop - p));
+    if (cr) {
+      *next_line = (cr + 1 < end && cr[1] == '\n') ? cr + 2 : cr + 1;
+      return cr;
+    }
   }
   *next_line = nl ? nl + 1 : end + 1;
   return nl ? nl : end;
+}
+
+inline bool chunk_has_cr(const char* buf, int64_t len) {
+  return memchr(buf, '\r', len) != nullptr;
 }
 
 }  // namespace
@@ -176,11 +191,12 @@ int ps_parse_libsvm(const char* buf, int64_t len,
                     int64_t* out_rows, int64_t* out_nnz, int64_t* err_line) {
   const char* p = buf;
   const char* end = buf + len;
+  const bool any_cr = chunk_has_cr(buf, len);
   int64_t rows = 0, nnz = 0, line = 0;
   row_splits[0] = 0;
   while (p < end) {
     const char* next_line;
-    const char* line_end = find_line_end(p, end, &next_line);
+    const char* line_end = find_line_end(p, end, &next_line, any_cr);
     skip_ws(p, line_end);
     if (p >= line_end) {  // blank line
       p = next_line;
@@ -210,7 +226,7 @@ int ps_parse_libsvm(const char* buf, int64_t len,
       if (nnz >= max_nnz) return -1;
       keys[nnz] = k;
       vals[nnz] = v;
-      slots[nnz] = 0;
+      if (slots) slots[nnz] = 0;  // null for slotless callers
       ++nnz;
     }
     ++rows;
@@ -234,11 +250,12 @@ int ps_parse_criteo(const char* buf, int64_t len,
   (void)err_line;  // criteo skips malformed lines instead of erroring
   const char* p = buf;
   const char* end = buf + len;
+  const bool any_cr = chunk_has_cr(buf, len);
   int64_t rows = 0, nnz = 0, line = 0;
   row_splits[0] = 0;
   while (p < end) {
     const char* next_line;
-    const char* line_end = find_line_end(p, end, &next_line);
+    const char* line_end = find_line_end(p, end, &next_line, any_cr);
     if (p >= line_end) {
       p = next_line;
       ++line;
@@ -419,11 +436,12 @@ int ps_parse_adfea(const char* buf, int64_t len,
                    int64_t* out_rows, int64_t* out_nnz, int64_t* err_line) {
   const char* p = buf;
   const char* end = buf + len;
+  const bool any_cr = chunk_has_cr(buf, len);
   int64_t rows = 0, nnz = 0, line = 0;
   row_splits[0] = 0;
   while (p < end) {
     const char* next_line;
-    const char* line_end = find_line_end(p, end, &next_line);
+    const char* line_end = find_line_end(p, end, &next_line, any_cr);
     skip_ws(p, line_end);
     if (p >= line_end) {  // blank line
       p = next_line;

@@ -14,6 +14,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from collections.abc import Iterator
 from pathlib import Path
 
@@ -22,8 +23,9 @@ import numpy as np
 _NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
 _LIB_ENV = "PS_TPU_NATIVE_LIB"
 
-FlatRows = tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]
-# (labels (R,), row_splits (R+1,), keys (N,), vals (N,), slots (N,))
+FlatRows = tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, "np.ndarray | None"]
+# (labels (R,), row_splits (R+1,), keys (N,), vals (N,), slots (N,) or
+#  None for SLOTLESS_FORMATS — all slot ids are 0 there)
 
 # Formats with a native fast path; the single source of truth for the
 # reader's backend="auto" choice and parse_chunk dispatch.
@@ -146,53 +148,98 @@ def hash_localize(
     return unique[:u], inverse[:n]
 
 
+# Formats whose slot id is constant 0 (libsvm): the slots array is pure
+# zeros, so the wrapper returns None instead of copying megabytes of
+# zeros per chunk — downstream (BatchBuilder.build_flat) treats None as
+# salt 0, which hashes identically.
+SLOTLESS_FORMATS = frozenset({"libsvm"})
+
+# Grow-only per-thread scratch for the parser outputs: fresh np.empty of
+# ~80 MB per 8 MB chunk costs a page-fault storm every call (measured:
+# the raw C parse runs ~480 MB/s but the old allocate-per-call wrapper
+# delivered ~205). Real data is copied out, so reuse is safe. Slotless
+# formats carry no slots scratch at all (the parser takes NULL).
+_scratch = threading.local()
+
+
+def _scratch_bufs(max_rows: int, max_nnz: int, want_slots: bool) -> dict:
+    """Per-array grow-only: only undersized (or newly needed) buffers are
+    reallocated, so the nnz-overflow retry and a format switch don't churn
+    the still-valid large arrays."""
+    s = getattr(_scratch, "bufs", None)
+    if s is None:
+        s = {"labels": None, "row_splits": None, "keys": None,
+             "vals": None, "slots": None}
+        _scratch.bufs = s
+    if s["labels"] is None or len(s["labels"]) < max_rows:
+        s["labels"] = np.empty(max_rows, dtype=np.float32)
+        s["row_splits"] = np.empty(max_rows + 1, dtype=np.int64)
+    if s["keys"] is None or len(s["keys"]) < max_nnz:
+        s["keys"] = np.empty(max_nnz, dtype=np.uint64)
+        s["vals"] = np.empty(max_nnz, dtype=np.float32)
+        s["slots"] = np.empty(max_nnz, dtype=np.uint64) if want_slots else None
+    elif want_slots and (s["slots"] is None or len(s["slots"]) < len(s["keys"])):
+        s["slots"] = np.empty(len(s["keys"]), dtype=np.uint64)
+    return s
+
+
 def parse_chunk(fmt: str, chunk: bytes, max_rows_hint: int = 0) -> FlatRows:
-    """Parse a buffer of complete lines via the C parser."""
+    """Parse a buffer of complete lines via the C parser. ``slots`` in the
+    returned tuple is None for SLOTLESS_FORMATS."""
     lib = load_native()
     if lib is None:
         raise RuntimeError("native parser not available")
     if not chunk.endswith(b"\n"):
         chunk += b"\n"
-    # capacity heuristics: a row is >= 4 bytes; an entry is >= 2 bytes.
-    # '\r' counts too: the C parser splits rows on lone CR (classic-Mac files)
-    max_rows = max(max_rows_hint, chunk.count(b"\n") + chunk.count(b"\r") + 1)
-    max_nnz = max(64, len(chunk) // 2)
-    labels = np.empty(max_rows, dtype=np.float32)
-    row_splits = np.empty(max_rows + 1, dtype=np.int64)
-    keys = np.empty(max_nnz, dtype=np.uint64)
-    vals = np.empty(max_nnz, dtype=np.float32)
-    slots = np.empty(max_nnz, dtype=np.uint64)
-    out_rows = ctypes.c_int64()
-    out_nnz = ctypes.c_int64()
-    err_line = ctypes.c_int64(-1)
     if fmt not in NATIVE_FORMATS:
         raise ValueError(f"native parser: unknown format {fmt!r}")
     fn = getattr(lib, NATIVE_FORMATS[fmt])
-    rc = fn(
-        chunk,
-        len(chunk),
-        max_rows,
-        max_nnz,
-        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        row_splits.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        slots.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-        ctypes.byref(out_rows),
-        ctypes.byref(out_nnz),
-        ctypes.byref(err_line),
-    )
+    # capacity: rows from the newline count (exact bound; '\r' counts too —
+    # the C parser splits rows on lone CR). Entries start from a realistic
+    # ~6 bytes/entry estimate and double on overflow (the hard floor is 2
+    # bytes/entry, but sizing scratch for it quadruples resident memory)
+    max_rows = max(max_rows_hint, chunk.count(b"\n") + chunk.count(b"\r") + 1)
+    max_nnz = max(64, len(chunk) // 6)
+    hard_cap = max(64, len(chunk) // 2 + 1)
+    want_slots = fmt not in SLOTLESS_FORMATS
+    while True:
+        s = _scratch_bufs(max_rows, max_nnz, want_slots)
+        out_rows = ctypes.c_int64()
+        out_nnz = ctypes.c_int64()
+        err_line = ctypes.c_int64(-1)
+        rc = fn(
+            chunk,
+            len(chunk),
+            max_rows,
+            len(s["keys"]),
+            s["labels"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            s["row_splits"].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            s["keys"].ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            s["vals"].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            (
+                s["slots"].ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+                if want_slots
+                else None
+            ),
+            ctypes.byref(out_rows),
+            ctypes.byref(out_nnz),
+            ctypes.byref(err_line),
+        )
+        if rc == -1 and len(s["keys"]) < hard_cap:
+            max_nnz = min(2 * len(s["keys"]), hard_cap)
+            continue
+        break
     if rc == -1:
         raise RuntimeError("native parser capacity overflow (internal bug)")
     if rc == -2:
         raise ValueError(f"parse error at line {err_line.value} of chunk ({fmt})")
     r, n = out_rows.value, out_nnz.value
     return (
-        labels[:r].copy(),
-        row_splits[: r + 1].copy(),
-        keys[:n].copy(),
-        vals[:n].copy(),
-        slots[:n].copy(),
+        s["labels"][:r].copy(),
+        s["row_splits"][: r + 1].copy(),
+        s["keys"][:n].copy(),
+        s["vals"][:n].copy(),
+        s["slots"][:n].copy() if want_slots else None,
     )
 
 

@@ -67,6 +67,10 @@ class MinibatchReader:
 
         bs, nnz_cap = self.builder.batch_size, self.builder.nnz_capacity
 
+        def take(slots, sl):
+            # slots is None for slotless formats (native.SLOTLESS_FORMATS)
+            return None if slots is None else slots[sl]
+
         def slices(flat):
             """Yield CSRBatches of full size from ``flat``; return leftover."""
             labels, splits, keys, vals, slots = flat
@@ -86,7 +90,7 @@ class MinibatchReader:
                         (splits[i : j + 1] - base),
                         keys[base : splits[j]],
                         vals[base : splits[j]],
-                        slots[base : splits[j]],
+                        take(slots, slice(base, splits[j])),
                     )
                     i = j
                 else:
@@ -97,7 +101,7 @@ class MinibatchReader:
                 splits[i:] - base,
                 keys[base:],
                 vals[base:],
-                slots[base:],
+                take(slots, slice(base, None)),
             )
 
         def cat(a, b):
@@ -108,7 +112,9 @@ class MinibatchReader:
                 np.concatenate([sa, sb[1:] + sa[-1]]),
                 np.concatenate([ka, kb]),
                 np.concatenate([va, vb]),
-                np.concatenate([oa, ob]),
+                # slots-ness is per-format, fixed per reader: both sides
+                # always agree
+                None if oa is None else np.concatenate([oa, ob]),
             )
 
         for _ in range(self.epochs):
@@ -197,7 +203,9 @@ def iter_flat_rows(files: list[str | Path], fmt: str):
     """Yield flat CSR chunks ``(labels, row_splits, keys, vals, slots)`` from
     text files — the raw-key stream consumed by ingest-side components that
     don't need batches (frequency filter warmup, the sketch app). Native
-    chunk parser when available, else the Python row parsers."""
+    chunk parser when available, else the Python row parsers. ``slots`` is
+    None for slotless formats (native.SLOTLESS_FORMATS — all slot ids are
+    0 there) on BOTH backends, so consumers see one contract."""
     from parameter_server_tpu.data import native as _native
 
     paths = sorted(map(str, files))
@@ -221,5 +229,11 @@ def iter_flat_rows(files: list[str | Path], fmt: str):
                 np.asarray(splits, dtype=np.int64),
                 np.concatenate(keys) if keys else np.zeros(0, np.uint64),
                 np.concatenate(vals) if vals else np.zeros(0, np.float32),
-                np.concatenate(slots) if slots else np.zeros(0, np.uint64),
+                (
+                    None
+                    if fmt in _native.SLOTLESS_FORMATS
+                    else np.concatenate(slots)
+                    if slots
+                    else np.zeros(0, np.uint64)
+                ),
             )

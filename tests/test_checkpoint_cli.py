@@ -376,7 +376,9 @@ class TestCLIAppFactory:
             "data": {"files": [str(tr_p)], "val_files": [str(val_p)]},
             "mf": {"num_users": n_u - 1, "num_items": n_i - 1, "rank": 8,
                    "eta": 0.1, "l2": 0.002, "batch_size": 500},
-            "solver": {"epochs": 12},
+            # steps_per_call: the CLI must wire solver.steps_per_call into
+            # the app (scanned multistep dispatch)
+            "solver": {"epochs": 12, "steps_per_call": 3},
             "parallel": {"data_shards": 2, "kv_shards": 4},
         }
         p = tmp_path / "mf.json"
@@ -404,7 +406,7 @@ class TestCLIAppFactory:
             "w2v": {"vocab_size": 16, "dim": 16, "window": 2,
                     "negatives": 4, "eta": 0.5, "batch_size": 1024,
                     "block_tokens": 2048},
-            "solver": {"epochs": 6, "max_delay": 1},
+            "solver": {"epochs": 6, "max_delay": 1, "steps_per_call": 2},
             "parallel": {"data_shards": 2, "kv_shards": 2},
         }
         p = tmp_path / "w2v.json"

@@ -20,6 +20,8 @@ pytestmark = pytest.mark.skipif(
 
 def rows_from_flat(flat):
     labels, splits, keys, vals, slots = flat
+    if slots is None:  # slotless formats elide the all-zero array
+        slots = np.zeros(len(keys), dtype=np.uint64)
     out = []
     for i in range(len(labels)):
         s, e = splits[i], splits[i + 1]
