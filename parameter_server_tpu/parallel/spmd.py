@@ -87,13 +87,33 @@ CSR_COMPACT_FIELDS = (
 )
 
 
+_F16_MAX = 65504.0  # largest finite float16
+
+
 def stack_batches(
-    batches: list[CSRBatch], mesh: Mesh | None = None, compact: bool = False
+    batches: list[CSRBatch],
+    mesh: Mesh | None = None,
+    compact: bool = False,
+    values_f16: bool = False,
 ) -> Batch:
-    """Stack D per-worker CSR batches; shard over "data"."""
-    return stack_fields(
-        batches, CSR_COMPACT_FIELDS if compact else CSR_FULL_FIELDS, mesh
+    """Stack D per-worker CSR batches; shard over "data".
+
+    values_f16 (the data.wire_values="f16" knob) halves the value bytes
+    on the feed: values are clipped to the finite f16 range (a silent
+    inf from an un-scaled count feature would NaN the loss and poison
+    the optimizer state) and cast; the device casts back to f32
+    (_values_of). One home for the encode so every feed path — train and
+    eval — gets the same wire."""
+    import numpy as np
+
+    out = stack_fields(
+        batches, CSR_COMPACT_FIELDS if compact else CSR_FULL_FIELDS, None
     )
+    if values_f16:
+        out["values"] = np.clip(out["values"], -_F16_MAX, _F16_MAX).astype(
+            np.float16
+        )
+    return out if mesh is None else place_stacked(out, mesh)
 
 
 def _row_ids_of(b: Batch) -> jax.Array:
@@ -108,6 +128,14 @@ def _row_ids_of(b: Batch) -> jax.Array:
     e = jnp.arange(nnz, dtype=jnp.int32)
     r = jnp.searchsorted(b["row_splits"], e, side="right").astype(jnp.int32) - 1
     return jnp.clip(r, 0, num_rows - 1)
+
+
+def _values_of(b: Batch) -> jax.Array:
+    """Feature values in compute precision: f16-wire batches (the
+    data.wire_values knob — half the value bytes on the feed) cast back
+    to f32 on-device; f32 wires pass through."""
+    v = b["values"]
+    return v.astype(jnp.float32) if v.dtype != jnp.float32 else v
 
 
 def _local_pull(
@@ -268,16 +296,17 @@ def _microstep(
     the wire semantics cannot diverge between them."""
     idx = b["unique_keys"]
     row_ids = _row_ids_of(b)
+    values = _values_of(b)
     w_u = lax.psum(
         _local_pull(updater, state_l, idx, shard_size), "kv"
     )  # Pull: slice + merge (ref kv_vector match)
     logits = csr_logits(
-        w_u, b["values"], b["local_ids"], row_ids,
+        w_u, values, b["local_ids"], row_ids,
         num_rows=b["labels"].shape[0],
     )
     loss, err = logistic_loss(logits, b["labels"], b["example_mask"])
     g = csr_grad(
-        err, b["values"], b["local_ids"], row_ids, num_unique=idx.shape[0]
+        err, values, b["local_ids"], row_ids, num_unique=idx.shape[0]
     )
     if push_mode == "aggregate":
         new_state = _local_push_aggregate(updater, state_l, idx, g, shard_size)
@@ -431,7 +460,7 @@ def make_spmd_predict_step(updater: Updater, mesh: Mesh, num_keys: int):
             _local_pull(updater, state_l, b["unique_keys"], shard_size), "kv"
         )
         logits = csr_logits(
-            w_u, b["values"], b["local_ids"], _row_ids_of(b),
+            w_u, _values_of(b), b["local_ids"], _row_ids_of(b),
             num_rows=b["labels"].shape[0],
         )
         return jax.nn.sigmoid(logits)[None, :]

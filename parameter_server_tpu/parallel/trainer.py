@@ -199,6 +199,11 @@ class PodTrainer:
                 f"{cfg.solver.steps_per_call}"
             )
         self.steps_per_call = cfg.solver.steps_per_call
+        if cfg.data.wire_values not in ("f32", "f16"):
+            raise ValueError(
+                f"data.wire_values must be 'f32' or 'f16', got "
+                f"{cfg.data.wire_values!r}"
+            )
         maker = (
             make_spmd_train_multistep
             if self.steps_per_call > 1
@@ -359,7 +364,9 @@ class PodTrainer:
         from parameter_server_tpu.data.batch import pad_group
 
         stacked = stack_batches(
-            pad_group(batches), None, compact=self.cfg.data.compact_wire
+            pad_group(batches), None,
+            compact=self.cfg.data.compact_wire,
+            values_f16=self.cfg.data.wire_values == "f16",
         )
         n = sum(b.num_examples for b in batches)
         labels = np.concatenate([b.labels[: b.num_examples] for b in batches])
@@ -646,6 +653,7 @@ class PodTrainer:
                     stack_batches(
                         batches, self.mesh,
                         compact=self.cfg.data.compact_wire,
+                        values_f16=self.cfg.data.wire_values == "f16",
                     ),
                 )
             )

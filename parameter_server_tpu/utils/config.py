@@ -46,6 +46,13 @@ class DataConfig:
     # bytes at typical densities; the device rebuilds row ids with one
     # searchsorted. False ships the full row_ids (debugging / parity runs)
     compact_wire: bool = True
+    # feature-value dtype on the host->device wire: "f32" (exact, default)
+    # or "f16" — half the value bytes; IEEE round-to-nearest quantization,
+    # cast back to f32 on-device before compute (the reference's
+    # fixing_float filter applied to the H2D feed instead of the
+    # server wire). Binary/one-hot features (criteo cats, adfea) are
+    # exactly representable; log1p-scaled ints lose <0.1% relative.
+    wire_values: str = "f32"
 
 
 @dataclass
