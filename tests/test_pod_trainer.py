@@ -171,3 +171,38 @@ class TestCriteoEndToEnd:
         assert t.examples_seen == 2 * 5400
         ev = t.evaluate_files([str(te)])
         assert ev["auc"] > 0.8, (last, ev)
+
+
+@pytest.fixture(scope="module")
+def base_ckpt(files, tmp_path_factory):
+    """One base (4, 2) model trained + checkpointed once, shared by every
+    elastic-restart parametrization."""
+    train, test = files
+    t = PodTrainer(make_cfg(epochs=1), reporter=quiet())
+    t.train_files(train, report_every=100)
+    ckpt = str(tmp_path_factory.mktemp("elastic") / "ck")
+    t.save(ckpt)
+    return ckpt, t.full_weights(), t.evaluate_files([test]), t.examples_seen
+
+
+class TestElasticRestart:
+    """Resume onto a DIFFERENT mesh shape (ref: servers reload their key
+    range after a topology change; here load assembles all shard files
+    and re-places on whatever mesh the new run has — elastic restart)."""
+
+    @pytest.mark.parametrize("new_shape", [(2, 4), (8, 1), (1, 8)])
+    def test_resume_across_mesh_shapes(self, files, base_ckpt, new_shape):
+        train, test = files
+        ckpt, w0, ev0, seen0 = base_ckpt
+        d, k = new_shape
+        t2 = PodTrainer(
+            make_cfg(epochs=1, data_shards=d, kv_shards=k), reporter=quiet()
+        )
+        t2.load(ckpt)
+        np.testing.assert_array_equal(t2.full_weights(), w0)
+        assert t2.examples_seen == seen0
+        ev1 = t2.evaluate_files([test])
+        assert ev1["auc"] == pytest.approx(ev0["auc"], abs=1e-6)
+        # and training continues on the new mesh
+        last = t2.train_files(train, report_every=100)
+        assert last["auc"] > ev0["auc"] - 0.05
