@@ -21,6 +21,7 @@ from __future__ import annotations
 import itertools
 import time
 from collections.abc import Iterator
+from typing import Any
 
 import jax
 import numpy as np
@@ -48,6 +49,11 @@ from parameter_server_tpu.utils.metrics import ProgressReporter
 # process-wide trainer sequence for control-plane KV namespacing (see
 # PodTrainer._bucket_ns)
 _TRAINER_SEQ = itertools.count()
+
+# eval's bounded async-dispatch depth (see PodTrainer.evaluate_files):
+# enough to overlap host batch-build with device predict, small enough
+# that queued input/result buffers stay a constant HBM footprint
+_EVAL_INFLIGHT = 2
 
 
 class _WorkerStream:
@@ -633,9 +639,23 @@ class PodTrainer:
 
         builder = eval_builder(self.cfg, key_mode)
         reader = MinibatchReader(files, self.cfg.data.format, builder)
-        ys, ps = [], []
+        # bounded async dispatch (the train loop's DispatchWindow pattern):
+        # up to EVAL_INFLIGHT predicts ride JAX async dispatch — no
+        # host<->device sync per D-group — while retirement of the oldest
+        # keeps queued input/result buffers from accumulating in HBM
+        # without bound on large eval sets
+        pending: list[tuple[Any, list[np.ndarray]]] = []
+        ys: list[np.ndarray] = []
+        ps: list[np.ndarray] = []
 
-        def _flush(group: list[CSRBatch]) -> None:
+        def _retire_oldest() -> None:
+            probs_dev, labels_list = pending.pop(0)
+            probs = np.asarray(probs_dev)  # sync point, bounded by depth
+            for d, labels in enumerate(labels_list):
+                ps.append(probs[d, : len(labels)])
+                ys.append(labels)
+
+        def _dispatch(group: list[CSRBatch]) -> None:
             from parameter_server_tpu.data.batch import pad_group
 
             # fill every data shard with real batches (D at a time); only
@@ -647,28 +667,30 @@ class PodTrainer:
                     for _ in range(self.data_shards - len(group))
                 ]
             )
-            probs = np.asarray(
-                self.predict_fn(
-                    self.state,
-                    stack_batches(
-                        batches, self.mesh,
-                        compact=self.cfg.data.compact_wire,
-                        values_f16=self.cfg.data.wire_values == "f16",
-                    ),
-                )
+            probs_dev = self.predict_fn(
+                self.state,
+                stack_batches(
+                    batches, self.mesh,
+                    compact=self.cfg.data.compact_wire,
+                    values_f16=self.cfg.data.wire_values == "f16",
+                ),
             )
-            for d, b in enumerate(group):
-                ps.append(probs[d, : b.num_examples])
-                ys.append(b.labels[: b.num_examples])
+            pending.append(
+                (probs_dev, [b.labels[: b.num_examples] for b in group])
+            )
+            if len(pending) > _EVAL_INFLIGHT:
+                _retire_oldest()
 
         group: list[CSRBatch] = []
         for b in reader:
             group.append(b)
             if len(group) == self.data_shards:
-                _flush(group)
+                _dispatch(group)
                 group = []
         if group:
-            _flush(group)
+            _dispatch(group)
+        while pending:
+            _retire_oldest()
         y = np.concatenate(ys)
         p = np.concatenate(ps)
         return {"auc": M.auc(y, p), "logloss": M.logloss(y, p), "examples": len(y)}
