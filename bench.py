@@ -31,6 +31,9 @@ Extra fields:
                    parity config), SSP-pipelined dispatch.
     ingest       — host-side native parse MB/s + parse+localize ex/sec per
                    stream (bounds e2e on co-located hardware).
+  last_tpu_capture — present only on a CPU fallback (accelerator
+                   unreachable): names the newest committed
+                   BENCH_r*_local.json real-hardware capture.
 """
 
 from __future__ import annotations
@@ -442,6 +445,16 @@ def bench_w2v() -> dict:
 
 def main() -> None:
     platform = _ensure_reachable_backend()
+    extra = {}
+    if platform.startswith("cpu (fallback"):
+        # the tunnel can wedge mid-session; the most recent REAL-hardware
+        # capture is committed in-repo for the record
+        import glob
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        caps = sorted(glob.glob(os.path.join(here, "BENCH_r*_local.json")))
+        if caps:
+            extra["last_tpu_capture"] = os.path.basename(caps[-1])
     batches = _make_batches()
     baseline, baseline_runs = bench_numpy_baseline(batches)
     value, device_runs = bench_device(batches)
@@ -475,6 +488,7 @@ def main() -> None:
                     "word2vec": bench_w2v(),
                     "ingest": bench_ingest(),
                 },
+                **extra,
             }
         )
     )
