@@ -1,7 +1,7 @@
 """Benchmark suite: flagship sparse-LR FTRL throughput + sub-benches.
 
 Prints ONE JSON line. Headline fields (driver contract):
-  {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": R}
+  {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N}
 
 value       — steady-state training examples/sec of the fused device step
               (pull -> CSR grad -> FTRL push), median of 3 timed passes.
@@ -11,35 +11,54 @@ vs_baseline — speedup over a single-core numpy implementation of the exact
               auditable). BASELINE.md records why the true reference
               cannot be executed in this environment.
 
-Extra fields:
-  raw  — the individual timed passes behind the headline numbers.
-  sub  — sub-benches:
-    pallas_ftrl  — fused Pallas FTRL delta vs the jnp composite on the
-                   same rows (timed for real on TPU; correctness-checked
-                   in interpret mode on CPU where timing it is
-                   meaningless). If the kernel wins on TPU the headline
-                   step is re-run with use_pallas=True and the better
-                   number is reported (headline_use_pallas says which).
-    spmd_push    — per_worker vs aggregate push wall-clock on a
-                   (data=8, kv=1) mesh (8-device virtual CPU child
-                   process), substantiating the aggregate-mode claim
-                   with a measurement.
-    pipeline_e2e — end-to-end files -> trained AUC throughput through
-                   the parallel host input pipeline (parse + build +
-                   train), pipelined vs serial ingest.
-    word2vec     — fused-SGNS pairs/sec on the device (BASELINE's second
-                   parity config), SSP-pipelined dispatch.
-    ingest       — host-side native parse MB/s + parse+localize ex/sec per
-                   stream (bounds e2e on co-located hardware).
-  last_tpu_capture — present only on a CPU fallback (accelerator
-                   unreachable): names the newest committed
-                   BENCH_r*_local.json real-hardware capture.
+Orchestration (hardened against accelerator-tunnel outages): the parent
+process never initializes JAX. Each sub-bench runs in its OWN child
+process under a hard deadline — a mid-suite tunnel wedge costs one
+sub-bench, not the capture. After any child failure the backend is
+re-probed; if the accelerator is gone the remaining children run on the
+CPU fallback (recorded per child as "platform"). Children share a
+persistent XLA compilation cache so the split costs compile time once,
+ever, per program. The headline child runs FIRST so the contract fields
+exist even if everything after it dies.
+
+Sub-benches ("sub"):
+  pallas_ftrl  — fused Pallas FTRL delta vs the jnp composite on the same
+                 rows (timed for real on TPU; numerics-checked in
+                 interpret mode on CPU). If the kernel wins on TPU the
+                 headline step re-runs with use_pallas=True and the better
+                 number is the headline (raw.headline_use_pallas).
+  pipeline_e2e — end-to-end files -> trained AUC through the parallel
+                 host pipeline, as an in-process A/B matrix over the wire
+                 format {compact, full} x {f32, f16} (one process, one
+                 tunnel state: the ratios are attribution-safe; AUC per
+                 cell guards quantization).
+  ladder       — in-process feature ladder on the same e2e workload:
+                 serial -> pipelined -> steps_per_call K in {1, 4, 8} ->
+                 bucketing off, isolating each flag's contribution.
+  hbm_scale    — the fused FTRL step and a full-table dense update at
+                 num_keys = 2^27 (1 GiB of z+n state on TPU): rows/sec,
+                 effective HBM GB/s, and no-OOM at reference-shaped key
+                 counts (SURVEY §7.4 huge key spaces).
+  word2vec     — fused-SGNS pairs/sec (BASELINE's second parity config),
+                 K in {1, 8}, now with a single-core numpy SGNS baseline
+                 on identical batch semantics (vs_baseline).
+  matrix_fac   — MF rating-triple throughput (BASELINE's MovieLens-shaped
+                 config) with a single-core numpy baseline (vs_baseline).
+  spmd_push    — per_worker vs aggregate push wall-clock on a (data=8)
+                 virtual CPU mesh (multi-device modes can't run on one
+                 real chip; recorded as platform "cpu-sim").
+  ingest       — host-side native parse MB/s + parse+localize ex/s per
+                 stream (bounds e2e on co-located hardware).
+  last_tpu_capture — present only on a CPU fallback: names the newest
+                 committed BENCH_r*_local.json real-hardware capture.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
+import signal
 import statistics
 import subprocess
 import sys
@@ -47,33 +66,6 @@ import tempfile
 import time
 
 import numpy as np
-
-
-def _ensure_reachable_backend(probe_timeout_s: float = 240.0) -> str:
-    """Probe the configured JAX backend in a subprocess; fall back to CPU
-    when device init hangs or fails (e.g. an accelerator tunnel outage).
-    A wedged backend would otherwise hang this process un-killably inside
-    PJRT init; the subprocess keeps the timeout enforceable."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=probe_timeout_s,
-            env=dict(os.environ),
-        )
-        if r.returncode == 0 and r.stdout.strip():
-            return r.stdout.strip().splitlines()[-1]
-    except subprocess.TimeoutExpired:
-        pass
-    from parameter_server_tpu.utils.hostenv import force_cpu
-
-    force_cpu(os.environ)
-    # ambient site hooks may have imported jax already, freezing the platform
-    # default from the pre-fallback env; override via config as well
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    return "cpu (fallback: accelerator unreachable)"
 
 BATCH = 8192
 NNZ_PER = 32
@@ -83,16 +75,42 @@ BASELINE_BATCHES = 8
 REPEATS = 3
 ALPHA, BETA, L1, L2 = 0.1, 1.0, 1.0, 0.0
 
+# hard per-child deadlines (seconds). Generous vs expected runtime but
+# small enough that a wedged child can't eat the driver's whole window.
+CHILD_BUDGET_S = {
+    "headline": 360,
+    "pipeline_e2e": 480,
+    "ladder": 480,
+    "hbm_scale": 300,
+    "word2vec": 360,
+    "matrix_fac": 300,
+    "spmd_push": 300,
+    "ingest": 240,
+}
+# run order = value order: the contract fields land first, platform-bound
+# numbers next, platform-independent ones last
+CHILD_ORDER = (
+    "headline", "pipeline_e2e", "hbm_scale", "ladder", "word2vec",
+    "matrix_fac", "spmd_push", "ingest",
+)
 
-def _make_batches(n_batches: int = N_BATCHES):
+
+# ---------------------------------------------------------------------------
+# shared helpers (children only — the parent never imports jax)
+# ---------------------------------------------------------------------------
+
+
+def _make_batches(n_batches: int = N_BATCHES, num_keys: int = NUM_KEYS,
+                  feature_space: int = 1 << 18, seed: int = 7):
     from parameter_server_tpu.data.batch import BatchBuilder
     from parameter_server_tpu.data.synthetic import make_sparse_logistic
 
     labels, keys, vals, _ = make_sparse_logistic(
-        BATCH * n_batches, 1 << 18, nnz_per_example=NNZ_PER, noise=0.4, seed=7
+        BATCH * n_batches, feature_space, nnz_per_example=NNZ_PER,
+        noise=0.4, seed=seed,
     )
     builder = BatchBuilder(
-        num_keys=NUM_KEYS, batch_size=BATCH, max_nnz_per_example=4 * NNZ_PER
+        num_keys=num_keys, batch_size=BATCH, max_nnz_per_example=4 * NNZ_PER
     )
     return [
         builder.build(
@@ -102,7 +120,8 @@ def _make_batches(n_batches: int = N_BATCHES):
     ]
 
 
-def bench_device(batches, use_pallas: bool = False) -> tuple[float, list[float]]:
+def bench_device(batches, use_pallas: bool = False,
+                 num_keys: int = NUM_KEYS) -> tuple[float, list[float]]:
     """Median-of-REPEATS steady-state device throughput (examples/sec)."""
     import jax
 
@@ -124,7 +143,7 @@ def bench_device(batches, use_pallas: bool = False) -> tuple[float, list[float]]
         return time.perf_counter() - t0, steps
 
     def warm_state():
-        state = up.init(NUM_KEYS, 1)
+        state = up.init(num_keys, 1)
         state, out = train_step(up, state, dev_batches[0])  # warmup/compile
         jax.block_until_ready(out["loss_sum"])
         return state
@@ -241,9 +260,389 @@ def bench_pallas_ftrl() -> dict:
     }
 
 
-def bench_spmd_push_child() -> None:
-    """Child entry (8-device virtual CPU mesh): per_worker vs aggregate
-    push wall-clock on a (data=8, kv=1) mesh."""
+def _platform() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+# ---------------------------------------------------------------------------
+# children
+# ---------------------------------------------------------------------------
+
+
+def child_headline() -> dict:
+    """Driver-contract numbers: device FTRL step vs numpy baseline, plus
+    the Pallas-vs-XLA comparison (which may promote the headline)."""
+    batches = _make_batches()
+    baseline, baseline_runs = bench_numpy_baseline(batches)
+    value, device_runs = bench_device(batches)
+    headline_use_pallas = False
+    pallas = bench_pallas_ftrl()
+    if pallas.get("mode") == "real" and pallas.get("pallas_speedup", 0) > 1.0:
+        v2, runs2 = bench_device(batches, use_pallas=True)
+        pallas["headline_step_ex_per_sec_pallas"] = round(v2, 1)
+        if v2 > value:
+            value, device_runs = v2, runs2
+            headline_use_pallas = True
+    return {
+        "platform": _platform(),
+        "value": round(value, 1),
+        "vs_baseline": round(value / baseline, 2),
+        "raw": {
+            "device_ex_per_sec_runs": device_runs,
+            "baseline_ex_per_sec": round(baseline, 1),
+            "baseline_ex_per_sec_runs": baseline_runs,
+            "baseline_batches": BASELINE_BATCHES,
+            "headline_use_pallas": headline_use_pallas,
+        },
+        "pallas_ftrl": pallas,
+    }
+
+
+def _write_e2e_files(d: str, n: int, files: int) -> list[str]:
+    from parameter_server_tpu.data.synthetic import (
+        make_sparse_logistic,
+        write_libsvm,
+    )
+
+    labels, keys, vals, _ = make_sparse_logistic(
+        n, 1 << 16, nnz_per_example=NNZ_PER, noise=0.4, seed=23
+    )
+    paths = []
+    per = n // files
+    for i in range(files):
+        p = os.path.join(d, f"part-{i}.svm")
+        s = slice(i * per, (i + 1) * per)
+        write_libsvm(p, labels[s], keys[s], vals[s])
+        paths.append(p)
+    return paths
+
+
+def _e2e_run(paths: list[str], n: int, *, depth: int, k: int, delay: int,
+             bucket: bool = True, compact: bool = True,
+             wire_values: str = "f32") -> tuple[float, float]:
+    """One end-to-end files->AUC training run; returns (ex/s, auc)."""
+    from parameter_server_tpu.parallel.trainer import PodTrainer
+    from parameter_server_tpu.utils.config import PSConfig
+    from parameter_server_tpu.utils.metrics import ProgressReporter
+
+    cfg = PSConfig()
+    cfg.data.num_keys = NUM_KEYS
+    cfg.data.pipeline_depth = depth
+    cfg.data.bucket_nnz = bucket
+    cfg.data.compact_wire = compact
+    cfg.data.wire_values = wire_values
+    cfg.data.max_nnz_per_example = 4 * NNZ_PER
+    cfg.solver.minibatch = 4096
+    cfg.solver.steps_per_call = k
+    cfg.solver.max_delay = delay
+    cfg.penalty.lambda_l1 = L1
+    t = PodTrainer(cfg, reporter=ProgressReporter(print_fn=lambda *_: None))
+    t.train_files(paths[:1], report_every=1000)  # compile warmup
+    t0 = time.perf_counter()
+    last = t.train_files(paths, report_every=1000)
+    dt = time.perf_counter() - t0
+    return round(n / dt, 1), round(last.get("auc", float("nan")), 4)
+
+
+def child_pipeline_e2e() -> dict:
+    """Wire-format A/B matrix {compact, full} x {f32, f16} inside ONE
+    process (one tunnel state), all at the production fast path (K=8,
+    depth=2, delay=2, bucketed). AUC per cell: the f16 wire is only a
+    win if it holds AUC."""
+    n, files = 1 << 16, 4
+    out: dict = {"platform": _platform(), "config": "K=8 depth=2 delay=2 bucketed"}
+    with tempfile.TemporaryDirectory() as d:
+        paths = _write_e2e_files(d, n, files)
+        for compact, wv in (
+            (True, "f32"), (True, "f16"), (False, "f32"), (False, "f16"),
+        ):
+            label = f"{'compact' if compact else 'full'}_{wv}"
+            ex, auc = _e2e_run(
+                paths, n, depth=2, k=8, delay=2, compact=compact,
+                wire_values=wv,
+            )
+            out[f"{label}_ex_per_sec"] = ex
+            out[f"{label}_auc"] = auc
+    best = max(
+        (k[: -len("_ex_per_sec")] for k in out if k.endswith("_ex_per_sec")),
+        key=lambda k: out[f"{k}_ex_per_sec"],
+    )
+    out["fastest"] = best
+    # continuity with r1-r3 captures: the default-config cell under the
+    # old key names
+    out["pipelined_k8_ex_per_sec"] = out["compact_f32_ex_per_sec"]
+    out["auc_k8"] = out["compact_f32_auc"]
+    return out
+
+
+def child_ladder() -> dict:
+    """In-process feature ladder on the e2e workload: each rung toggles
+    one flag off the production config, so per-feature attribution never
+    spans tunnel states (VERDICT r3 weak #5)."""
+    n, files = 1 << 16, 4
+    out: dict = {"platform": _platform()}
+    with tempfile.TemporaryDirectory() as d:
+        paths = _write_e2e_files(d, n, files)
+        # one flag per rung: serial->pipelined toggles the thread pipeline
+        # alone (delay stays 0), async adds SSP run-ahead, k4/k8 add the
+        # scanned multistep, bucket_off removes nnz bucketing
+        rungs = {
+            "serial": dict(depth=0, k=1, delay=0),
+            "pipelined_k1": dict(depth=2, k=1, delay=0),
+            "async_k1": dict(depth=2, k=1, delay=2),
+            "k4": dict(depth=2, k=4, delay=2),
+            "k8": dict(depth=2, k=8, delay=2),
+            "k8_bucket_off": dict(depth=2, k=8, delay=2, bucket=False),
+        }
+        aucs = {}
+        for label, kw in rungs.items():
+            ex, aucs[label] = _e2e_run(paths, n, **kw)
+            out[f"{label}_ex_per_sec"] = ex
+        out["auc"] = aucs["k8"]
+    out["pipeline_speedup"] = round(
+        out["pipelined_k1_ex_per_sec"] / out["serial_ex_per_sec"], 3
+    )
+    out["runahead_speedup"] = round(
+        out["async_k1_ex_per_sec"] / out["pipelined_k1_ex_per_sec"], 3
+    )
+    out["k8_over_k1"] = round(
+        out["k8_ex_per_sec"] / out["async_k1_ex_per_sec"], 3
+    )
+    out["bucketing_speedup"] = round(
+        out["k8_ex_per_sec"] / out["k8_bucket_off_ex_per_sec"], 3
+    )
+    return out
+
+
+def child_hbm_scale() -> dict:
+    """The HBM-resident-state demonstration (SURVEY §7.4 huge key spaces):
+    fused FTRL step + full-table dense update at num_keys = 2^27 on TPU
+    (1 GiB of z+n state; ~2^27 is what one chip's HBM comfortably holds
+    next to batches). CPU fallback runs 2^24 so the capture stays honest
+    about what ran where."""
+    import jax
+    import jax.numpy as jnp
+
+    from parameter_server_tpu.kv.updaters import Ftrl
+
+    plat = _platform()
+    log2 = 27 if plat == "tpu" else 24
+    num_keys = 1 << log2
+    out: dict = {
+        "platform": plat,
+        "num_keys_log2": log2,
+        "state_bytes": 2 * num_keys * 4,  # z + n, f32
+    }
+    # sparse path: the real train step over a huge table — gather/scatter
+    # bandwidth at reference-shaped key counts (keys Zipf-hashed into the
+    # full 2^27 space)
+    batches = _make_batches(
+        n_batches=8, num_keys=num_keys, feature_space=1 << 24, seed=7
+    )
+    touched = int(np.mean([b.num_unique for b in batches]))
+    ex_s, runs = bench_device(batches, num_keys=num_keys)
+    out["sparse_step_ex_per_sec"] = round(ex_s, 1)
+    out["sparse_step_runs"] = runs
+    out["touched_rows_per_step"] = touched
+    # ~5 arrays of touched rows move per step (z, n read + z, n write + g)
+    out["sparse_step_touched_mb"] = round(touched * 5 * 4 / 1e6, 2)
+
+    # dense path: FTRL updates over EVERY row — 5 f32 streams over the
+    # whole table per pass; rows/sec * 20 B = effective HBM bandwidth.
+    # The passes chain inside ONE jitted fori_loop (a real z/n dependency
+    # chain, so nothing is DCE'd): one dispatch, in-place buffer reuse —
+    # a host loop of async calls would stack un-retired 1 GiB outputs in
+    # HBM (the unbounded-dispatch failure eval had to bound)
+    from jax import lax
+
+    up = Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2)
+    rng = np.random.default_rng(5)
+    z = jnp.asarray(rng.normal(size=(num_keys, 1)).astype(np.float32))
+    nacc = jnp.asarray(
+        np.abs(rng.normal(size=(num_keys, 1))).astype(np.float32)
+    )
+    g = jnp.asarray(rng.normal(size=(num_keys, 1)).astype(np.float32))
+
+    @jax.jit
+    def passes(z, n, g, iters):
+        def body(_, c):
+            d = up.delta({"z": c[0], "n": c[1]}, g)
+            return (c[0] + d["z"], c[1] + d["n"])
+
+        return lax.fori_loop(0, iters, body, (z, n))
+
+    jax.block_until_ready(passes(z, nacc, g, 1))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(passes(z, nacc, g, 2))
+    probe = max((time.perf_counter() - t0) / 2, 1e-4)
+    iters = min(max(3, int(1.0 / probe)), 200)
+    t0 = time.perf_counter()
+    jax.block_until_ready(passes(z, nacc, g, iters))
+    dt = time.perf_counter() - t0
+    rows_s = num_keys * iters / dt
+    out["dense_passes"] = iters
+    out["dense_rows_per_sec"] = round(rows_s, 1)
+    out["dense_hbm_gb_per_sec"] = round(rows_s * 20 / 1e9, 1)
+    return out
+
+
+def child_word2vec() -> dict:
+    """word2vec SGNS throughput (BASELINE's second parity config) at
+    steps_per_call 1 and 8, plus a single-core numpy SGNS baseline with
+    identical batch semantics (adagrad tables, scatter-add of deltas)."""
+    from parameter_server_tpu.models.word2vec import Word2Vec
+    from parameter_server_tpu.utils.metrics import ProgressReporter
+
+    vocab, dim, n_tokens, neg = 1 << 16, 64, 1 << 20, 5
+    rng = np.random.default_rng(11)
+    corpus = rng.integers(0, vocab, n_tokens)
+    bs = 8192
+    total = 2 * (2 * n_tokens - 3)  # window=2 skip-gram pair count
+    pairs = total // bs * bs  # only full batches are dispatched
+    out: dict = {
+        "platform": _platform(), "vocab": vocab, "dim": dim, "negatives": neg,
+    }
+    for k in (1, 8):
+        w2v = Word2Vec(
+            vocab_size=vocab, dim=dim, eta=0.1, num_negatives=neg, window=2,
+            # SSP run-ahead: without it every call pays a full
+            # host<->device round trip on loss retirement
+            max_delay=8,
+            steps_per_call=k,
+            reporter=ProgressReporter(print_fn=lambda *_: None),
+        )
+        w2v.train_epoch(corpus[: 1 << 17], batch_size=bs, seed=0)  # warmup
+        t0 = time.perf_counter()
+        w2v.train_epoch(corpus, batch_size=bs, seed=1)
+        dt = time.perf_counter() - t0
+        key = "pairs_per_sec" if k == 1 else f"pairs_per_sec_k{k}"
+        out[key] = round(pairs / dt, 1)
+    out["multistep_speedup"] = round(
+        out["pairs_per_sec_k8"] / out["pairs_per_sec"], 3
+    )
+
+    # single-core numpy baseline: the same SGNS math (einsum logits,
+    # softplus loss, adagrad deltas, np.add.at scatter — the duplicate-id
+    # semantics of the device step) on identical batch shapes
+    n_base = 8  # batches per timed pass
+    centers = rng.integers(0, vocab, n_base * bs).astype(np.int32)
+    contexts = rng.integers(0, vocab, n_base * bs).astype(np.int32)
+    negs = rng.integers(0, vocab, (n_base * bs, neg)).astype(np.int32)
+    eta, eps = 0.1, 1e-8
+    runs = []
+    for _ in range(REPEATS):
+        w_in = rng.uniform(-0.5 / dim, 0.5 / dim, (vocab, dim)).astype(np.float32)
+        n_in = np.zeros((vocab, dim), np.float32)
+        w_out = np.zeros((vocab, dim), np.float32)
+        n_out = np.zeros((vocab, dim), np.float32)
+        labels = np.concatenate(
+            [np.ones((bs, 1), np.float32), np.zeros((bs, neg), np.float32)],
+            axis=1,
+        )
+        t0 = time.perf_counter()
+        for i in range(n_base):
+            s = slice(i * bs, (i + 1) * bs)
+            c = centers[s]
+            out_ids = np.concatenate(
+                [contexts[s][:, None], negs[s]], axis=1
+            ).reshape(-1)
+            u = w_in[c]  # (B, d)
+            v = w_out[out_ids].reshape(bs, 1 + neg, dim)
+            logits = np.einsum("bd,bkd->bk", u, v)
+            err = 1.0 / (1.0 + np.exp(-logits)) - labels
+            g_u = np.einsum("bk,bkd->bd", err, v)
+            g_v = (err[:, :, None] * u[:, None, :]).reshape(-1, dim)
+            # adagrad deltas from the PULLED rows, then scatter-add
+            nu = n_in[c] + g_u * g_u
+            np.add.at(n_in, c, g_u * g_u)
+            np.add.at(w_in, c, -eta * g_u / (np.sqrt(nu) + eps))
+            nv = n_out[out_ids] + g_v * g_v
+            np.add.at(n_out, out_ids, g_v * g_v)
+            np.add.at(w_out, out_ids, -eta * g_v / (np.sqrt(nv) + eps))
+        runs.append(n_base * bs / (time.perf_counter() - t0))
+    base = statistics.median(runs)
+    out["baseline_pairs_per_sec"] = round(base, 1)
+    out["baseline_runs"] = [round(r, 1) for r in runs]
+    out["vs_baseline"] = round(out["pairs_per_sec_k8"] / base, 2)
+    return out
+
+
+def child_matrix_fac() -> dict:
+    """Matrix-factorization rating-triple throughput (BASELINE's MovieLens
+    parity config shape: rank-64 adagrad) plus a single-core numpy
+    baseline running the same per-batch algorithm (unique + segment-sum
+    grads + adagrad scatter)."""
+    from parameter_server_tpu.models.matrix_fac import (
+        MatrixFactorization,
+        MFBatchBuilder,
+    )
+    from parameter_server_tpu.utils.metrics import ProgressReporter
+
+    users_n = items_n = (1 << 16) - 1
+    rank, bs, n = 64, 8192, 1 << 19
+    rng = np.random.default_rng(17)
+    users = rng.integers(0, users_n, n)
+    items = rng.integers(0, items_n, n)
+    ratings = (rng.normal(size=n) + 3.5).astype(np.float32)
+    out: dict = {
+        "platform": _platform(), "rank": rank, "ratings": n,
+    }
+    app = MatrixFactorization(
+        users_n, items_n, rank=rank, eta=0.05, l2=0.01, algo="adagrad",
+        seed=0, max_delay=4, steps_per_call=8,
+        reporter=ProgressReporter(print_fn=lambda *_: None),
+    )
+    app.train_epoch(
+        users[: bs * 8], items[: bs * 8], ratings[: bs * 8], batch_size=bs
+    )
+    t0 = time.perf_counter()
+    app.train_epoch(users, items, ratings, batch_size=bs, seed=1)
+    dt = time.perf_counter() - t0
+    out["pairs_per_sec_k8"] = round(n / dt, 1)
+
+    # numpy baseline: same math per batch over the same triples
+    l2, eta, eps = 0.01, 0.05, 1e-8
+    builder = MFBatchBuilder(bs)
+    n_base = 8
+    runs = []
+    for _ in range(REPEATS):
+        U = rng.normal(scale=0.1, size=(users_n + 1, rank)).astype(np.float32)
+        V = rng.normal(scale=0.1, size=(items_n + 1, rank)).astype(np.float32)
+        U[0] = V[0] = 0.0  # pad row, as in the device tables
+        Un = np.zeros_like(U)
+        Vn = np.zeros_like(V)
+        t0 = time.perf_counter()
+        for i in range(n_base):
+            s = slice(i * bs, (i + 1) * bs)
+            b = builder.build(users[s], items[s], ratings[s])
+            u = U[b.user_keys][b.user_ids]
+            v = V[b.item_keys][b.item_ids]
+            err = (np.sum(u * v, axis=1) - b.ratings) * b.mask
+            g_u = np.zeros((len(b.user_keys), rank), np.float32)
+            np.add.at(g_u, b.user_ids, err[:, None] * v)
+            g_u += l2 * U[b.user_keys] * (np.arange(len(b.user_keys)) > 0)[:, None]
+            g_v = np.zeros((len(b.item_keys), rank), np.float32)
+            np.add.at(g_v, b.item_ids, err[:, None] * u)
+            g_v += l2 * V[b.item_keys] * (np.arange(len(b.item_keys)) > 0)[:, None]
+            for W, N, keys, g in (
+                (U, Un, b.user_keys, g_u), (V, Vn, b.item_keys, g_v),
+            ):
+                nn = N[keys] + g * g
+                np.add.at(N, keys, g * g)
+                np.add.at(W, keys, -eta * g / (np.sqrt(nn) + eps))
+        runs.append(n_base * bs / (time.perf_counter() - t0))
+    base = statistics.median(runs)
+    out["baseline_pairs_per_sec"] = round(base, 1)
+    out["baseline_runs"] = [round(r, 1) for r in runs]
+    out["vs_baseline"] = round(out["pairs_per_sec_k8"] / base, 2)
+    return out
+
+
+def child_spmd_push() -> dict:
+    """per_worker vs aggregate push wall-clock on a (data=8, kv=1) virtual
+    CPU mesh (the parent forces the CPU-sim env for this child)."""
     import jax
 
     from parameter_server_tpu.data.batch import BatchBuilder
@@ -288,89 +687,10 @@ def bench_spmd_push_child() -> None:
     out["aggregate_speedup"] = round(
         out["aggregate_ex_per_sec"] / out["per_worker_ex_per_sec"], 3
     )
-    print(json.dumps(out))
-
-
-def bench_spmd_push() -> dict:
-    """Run the (data=8) push-mode comparison in an 8-device CPU child."""
-    from parameter_server_tpu.utils.hostenv import force_cpu
-
-    env = dict(os.environ)
-    force_cpu(env)
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--spmd-push-child"],
-            capture_output=True, text=True, timeout=900, env=env,
-        )
-        if r.returncode == 0 and r.stdout.strip():
-            return json.loads(r.stdout.strip().splitlines()[-1])
-        return {"error": (r.stderr or "no output").strip()[-500:]}
-    except subprocess.TimeoutExpired:
-        return {"error": "spmd push child timed out"}
-
-
-def bench_pipeline_e2e() -> dict:
-    """End-to-end files -> trained AUC throughput (parse + batch build +
-    train) through the parallel host pipeline, vs serial inline ingest."""
-    from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
-    from parameter_server_tpu.parallel.trainer import PodTrainer
-    from parameter_server_tpu.utils.config import PSConfig
-    from parameter_server_tpu.utils.metrics import ProgressReporter
-
-    n, files = 1 << 16, 4
-    labels, keys, vals, _ = make_sparse_logistic(
-        n, 1 << 16, nnz_per_example=NNZ_PER, noise=0.4, seed=23
-    )
-    out: dict = {}
-    with tempfile.TemporaryDirectory() as d:
-        paths = []
-        per = n // files
-        for i in range(files):
-            p = os.path.join(d, f"part-{i}.svm")
-            s = slice(i * per, (i + 1) * per)
-            write_libsvm(p, labels[s], keys[s], vals[s])
-            paths.append(p)
-        out["bucket_nnz"] = True
-        # pipelined_k8: the production fast path — scanned multistep
-        # (steps_per_call=8) + SSP run-ahead (max_delay=2, overlapping
-        # transfer with compute) on top of the threaded pipeline, compact
-        # wire. pipelined/serial stay at K=1/delay=0 to isolate the
-        # thread-pipeline contrast.
-        for depth, k, delay, label in (
-            (2, 8, 2, "pipelined_k8"), (2, 1, 0, "pipelined"),
-            (0, 1, 0, "serial"),
-        ):
-            cfg = PSConfig()
-            cfg.data.num_keys = NUM_KEYS
-            cfg.data.pipeline_depth = depth
-            # bucketed static shapes: host->device bytes track the real
-            # batch density instead of the max_nnz_per_example worst case
-            # (measured 3.5x end-to-end on the tunneled TPU at this shape)
-            cfg.data.bucket_nnz = True
-            cfg.data.max_nnz_per_example = 4 * NNZ_PER
-            cfg.solver.minibatch = 4096
-            cfg.solver.steps_per_call = k
-            cfg.solver.max_delay = delay
-            cfg.penalty.lambda_l1 = L1
-            t = PodTrainer(cfg, reporter=ProgressReporter(print_fn=lambda *_: None))
-            t.train_files(paths[:1], report_every=1000)  # compile warmup
-            t0 = time.perf_counter()
-            last = t.train_files(paths, report_every=1000)
-            dt = time.perf_counter() - t0
-            out[f"{label}_ex_per_sec"] = round(n / dt, 1)
-            if depth == 2:
-                out["auc" if k == 1 else "auc_k8"] = round(
-                    last.get("auc", float("nan")), 4
-                )
     return out
 
 
-def bench_ingest() -> dict:
+def child_ingest() -> dict:
     """Host ingest throughput (platform-independent): native parse-only
     MB/s and parse+build (localize) examples/sec per stream — the numbers
     that bound e2e on co-located hardware (SURVEY §7.4: the parser must be
@@ -378,7 +698,10 @@ def bench_ingest() -> dict:
     from parameter_server_tpu.data import native
     from parameter_server_tpu.data.batch import BatchBuilder
     from parameter_server_tpu.data.reader import MinibatchReader
-    from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
+    from parameter_server_tpu.data.synthetic import (
+        make_sparse_logistic,
+        write_libsvm,
+    )
 
     n = 1 << 17
     labels, keys, vals, _ = make_sparse_logistic(
@@ -390,9 +713,12 @@ def bench_ingest() -> dict:
         write_libsvm(p, labels, keys, vals)
         sz = os.path.getsize(p)
         if native.native_available():
-            t0 = time.perf_counter()
-            rows = sum(len(fl[0]) for fl in native.iter_chunks(p, "libsvm"))
-            dt = time.perf_counter() - t0
+            runs = []
+            for _ in range(REPEATS):
+                t0 = time.perf_counter()
+                rows = sum(len(fl[0]) for fl in native.iter_chunks(p, "libsvm"))
+                runs.append(time.perf_counter() - t0)
+            dt = statistics.median(runs)
             out["parse_mb_per_sec"] = round(sz / dt / 1e6, 1)
             out["parse_ex_per_sec"] = round(rows / dt, 1)
         builder = BatchBuilder(
@@ -406,88 +732,181 @@ def bench_ingest() -> dict:
     return out
 
 
-def bench_w2v() -> dict:
-    """word2vec SGNS throughput on the device (BASELINE's second parity
-    config): two vocab-sized embedding tables, fused SGNS step, pairs/sec
-    after compile warmup. Measured at steps_per_call 1 AND 8: the scanned
-    multistep path amortizes the per-call host<->device round trips that
-    floor-bound the K=1 number on a tunneled chip."""
-    from parameter_server_tpu.models.word2vec import Word2Vec
-    from parameter_server_tpu.utils.metrics import ProgressReporter
+_CHILDREN = {
+    "headline": child_headline,
+    "pipeline_e2e": child_pipeline_e2e,
+    "ladder": child_ladder,
+    "hbm_scale": child_hbm_scale,
+    "word2vec": child_word2vec,
+    "matrix_fac": child_matrix_fac,
+    "spmd_push": child_spmd_push,
+    "ingest": child_ingest,
+}
 
-    vocab, dim, n_tokens = 1 << 16, 64, 1 << 20
-    rng = np.random.default_rng(11)
-    corpus = rng.integers(0, vocab, n_tokens)
-    bs = 8192
-    total = 2 * (2 * n_tokens - 3)  # window=2 skip-gram pair count
-    pairs = total // bs * bs  # only full batches are dispatched
-    out: dict = {"vocab": vocab, "dim": dim, "negatives": 5}
-    for k in (1, 8):
-        w2v = Word2Vec(
-            vocab_size=vocab, dim=dim, eta=0.1, num_negatives=5, window=2,
-            # SSP run-ahead: without it every call pays a full
-            # host<->device round trip on loss retirement
-            max_delay=8,
-            steps_per_call=k,
-            reporter=ProgressReporter(print_fn=lambda *_: None),
+
+# ---------------------------------------------------------------------------
+# parent orchestration (never imports jax)
+# ---------------------------------------------------------------------------
+
+
+def _base_child_env() -> dict:
+    env = dict(os.environ)
+    # persistent XLA compilation cache: the per-child process split costs
+    # each program's compile once ever, and a repeat bench run (the
+    # driver's) starts warm
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/ps_tpu_jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    return env
+
+
+def _cpu_sim_env(n_devices: int = 8) -> dict:
+    from parameter_server_tpu.utils.hostenv import force_cpu
+
+    env = _base_child_env()
+    force_cpu(env)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    return env
+
+
+def _probe_backend(env: dict, timeout_s: float) -> str | None:
+    """Ask a subprocess what platform jax.devices() resolves to; None on
+    wedge/timeout/failure. The subprocess keeps the timeout enforceable —
+    a wedged PJRT init inside THIS process would be unkillable."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
         )
-        w2v.train_epoch(corpus[: 1 << 17], batch_size=bs, seed=0)  # warmup
-        t0 = time.perf_counter()
-        w2v.train_epoch(corpus, batch_size=bs, seed=1)
-        dt = time.perf_counter() - t0
-        key = "pairs_per_sec" if k == 1 else f"pairs_per_sec_k{k}"
-        out[key] = round(pairs / dt, 1)
-    out["multistep_speedup"] = round(
-        out["pairs_per_sec_k8"] / out["pairs_per_sec"], 3
-    )
-    return out
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
+def _run_child(name: str, env: dict, timeout_s: float) -> dict:
+    """Run one sub-bench child under a hard deadline. Children are started
+    in their own session so a wedged PJRT thread can be killed as a group;
+    if SIGKILL doesn't take (D-state on the tunnel), the child is abandoned
+    and the suite moves on."""
+    t0 = time.perf_counter()
+    with tempfile.TemporaryFile(mode="w+") as fout, \
+            tempfile.TemporaryFile(mode="w+") as ferr:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child", name],
+            stdout=fout, stderr=ferr, env=env, start_new_session=True,
+        )
+        deadline = t0 + timeout_s
+        while proc.poll() is None and time.perf_counter() < deadline:
+            time.sleep(0.25)
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # abandoned: unkillable in D-state on a wedged tunnel
+            return {"error": f"timeout after {timeout_s:.0f}s"}
+        fout.seek(0)
+        lines = fout.read().strip().splitlines()
+        if proc.returncode == 0 and lines:
+            try:
+                out = json.loads(lines[-1])
+                out["wall_s"] = round(time.perf_counter() - t0, 1)
+                return out
+            except json.JSONDecodeError:
+                pass
+        ferr.seek(0)
+        return {"error": (ferr.read() or "no output").strip()[-500:]}
+
+
+def _newest_tpu_capture() -> str | None:
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    caps = glob.glob(os.path.join(here, "BENCH_r*_local.json"))
+    if not caps:
+        return None
+    # numeric round sort: lexicographic would rank r9 above r10
+    caps.sort(key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
+    return os.path.basename(caps[-1])
 
 
 def main() -> None:
-    platform = _ensure_reachable_backend()
-    extra = {}
-    if platform.startswith("cpu (fallback"):
-        # the tunnel can wedge mid-session; the most recent REAL-hardware
-        # capture is committed in-repo for the record
-        import glob
+    t_start = time.perf_counter()
+    env = _base_child_env()
+    platform = _probe_backend(env, timeout_s=240.0)
+    degraded = platform is None
+    if degraded:
+        from parameter_server_tpu.utils.hostenv import force_cpu
 
-        here = os.path.dirname(os.path.abspath(__file__))
-        caps = sorted(glob.glob(os.path.join(here, "BENCH_r*_local.json")))
-        if caps:
-            extra["last_tpu_capture"] = os.path.basename(caps[-1])
-    batches = _make_batches()
-    baseline, baseline_runs = bench_numpy_baseline(batches)
-    value, device_runs = bench_device(batches)
-    headline_use_pallas = False
-    pallas = bench_pallas_ftrl()
-    if pallas.get("mode") == "real" and pallas.get("pallas_speedup", 0) > 1.0:
-        v2, runs2 = bench_device(batches, use_pallas=True)
-        pallas["headline_step_ex_per_sec_pallas"] = round(v2, 1)
-        if v2 > value:
-            value, device_runs = v2, runs2
-            headline_use_pallas = True
+        force_cpu(env)
+        platform = "cpu (fallback: accelerator unreachable)"
+
+    results: dict = {}
+    for name in CHILD_ORDER:
+        child_env = _cpu_sim_env() if name == "spmd_push" else env
+        r = _run_child(name, child_env, CHILD_BUDGET_S[name])
+        results[name] = r
+        if "error" in r and name != "spmd_push" and not degraded:
+            # the accelerator may have wedged mid-suite: re-probe, and run
+            # everything that's left on the CPU fallback if it's gone
+            if _probe_backend(env, timeout_s=90.0) is None:
+                from parameter_server_tpu.utils.hostenv import force_cpu
+
+                force_cpu(env)
+                degraded = True
+                results[name]["degraded_after"] = True
+                if name == "headline":
+                    results[name] = _run_child(
+                        "headline", env, CHILD_BUDGET_S["headline"]
+                    )
+                    results[name]["platform"] = (
+                        "cpu (fallback: accelerator unreachable)"
+                    )
+
+    head = results.get("headline", {})
+    if "error" in head:  # headline died even after fallback: contract floor
+        head = {"platform": platform, "value": 0.0, "vs_baseline": 0.0,
+                "raw": {"error": head["error"]}}
+    top_platform = head.get("platform", platform)
+    if degraded and "tpu" not in str(top_platform):
+        top_platform = "cpu (fallback: accelerator unreachable)"
+    extra = {}
+    if "tpu" not in str(top_platform):
+        cap = _newest_tpu_capture()
+        if cap:
+            # the tunnel can wedge for a whole session; the most recent
+            # REAL-hardware capture is committed in-repo for the record
+            extra["last_tpu_capture"] = cap
     print(
         json.dumps(
             {
                 "metric": "sparse_lr_ftrl_train_throughput",
-                "value": round(value, 1),
+                "value": head.get("value", 0.0),
                 "unit": "examples/sec",
-                "vs_baseline": round(value / baseline, 2),
-                "platform": platform,
-                "raw": {
-                    "device_ex_per_sec_runs": device_runs,
-                    "baseline_ex_per_sec": round(baseline, 1),
-                    "baseline_ex_per_sec_runs": baseline_runs,
-                    "baseline_batches": BASELINE_BATCHES,
-                    "headline_use_pallas": headline_use_pallas,
-                },
+                "vs_baseline": head.get("vs_baseline", 0.0),
+                "platform": top_platform,
+                "raw": head.get("raw", {}),
                 "sub": {
-                    "pallas_ftrl": pallas,
-                    "spmd_push": bench_spmd_push(),
-                    "pipeline_e2e": bench_pipeline_e2e(),
-                    "word2vec": bench_w2v(),
-                    "ingest": bench_ingest(),
+                    "pallas_ftrl": head.get("pallas_ftrl", {}),
+                    "pipeline_e2e": results.get("pipeline_e2e", {}),
+                    "ladder": results.get("ladder", {}),
+                    "hbm_scale": results.get("hbm_scale", {}),
+                    "word2vec": results.get("word2vec", {}),
+                    "matrix_fac": results.get("matrix_fac", {}),
+                    "spmd_push": results.get("spmd_push", {}),
+                    "ingest": results.get("ingest", {}),
                 },
+                "suite_wall_s": round(time.perf_counter() - t_start, 1),
                 **extra,
             }
         )
@@ -495,13 +914,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--spmd-push-child" in sys.argv:
-        from parameter_server_tpu.utils.hostenv import force_cpu
-
-        force_cpu(os.environ)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        bench_spmd_push_child()
+    if "--child" in sys.argv:
+        name = sys.argv[sys.argv.index("--child") + 1]
+        print(json.dumps(_CHILDREN[name]()))
     else:
         main()

@@ -27,7 +27,7 @@ and gloo CPU collectives — exercised by tests/test_multihost.py.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any
 
 import numpy as np
@@ -44,6 +44,10 @@ class Runtime:
     data_shards: int  # global data axis size
     kv_shards: int
     local_data_shards: int  # data rows owned by this process
+    # cp_allmax's deferred-deletion slot (mutable on the frozen handle):
+    # holds the one previous tag whose published max is deleted on the
+    # next call — see cp_allmax's cleanup note
+    _cp_state: dict = field(default_factory=dict, repr=False, compare=False)
 
     # -- input sharding ---------------------------------------------------
 
@@ -188,12 +192,16 @@ class Runtime:
         step, so the control-plane cost does not grow with the pod on the
         dispatch critical path (process 0 pays O(P), off-device).
 
-        Cleanup: each process deletes its own post (and 0 the published
-        max) from two tags back — by the time any process starts
-        reduction t, every process completed t-1, which required the
-        published max of t-1, which required every post of t-1; so t-2
-        keys are dead. The final two tags of a sequence leak a few tiny
-        strings (reclaimed when the coordinator exits)."""
+        Cleanup (bounded across arbitrarily many calls/epochs/trainers):
+        a follower deletes its own post right after its get succeeds —
+        the published max existing proves process 0 already read every
+        post of this tag. Process 0 deletes the PREVIOUS call's max after
+        publishing the current one: its posts being all in proves every
+        process completed the previous call's get (calls are issued in
+        identical order per process). Steady-state KV footprint is
+        therefore exactly one `max` key; only the final call's max of a
+        Runtime's lifetime leaks (O(1), reclaimed when the coordinator
+        exits)."""
         if self.process_count == 1:
             return tuple(int(v) for v in values)
         from jax._src import distributed
@@ -203,8 +211,6 @@ class Runtime:
             return None
         me = self.process_index
         enc = ",".join(str(int(v)) for v in values)
-        gen, _, step = tag.rpartition("/")
-        dead = f"{gen}/{int(step) - 2}" if step.isdigit() and int(step) >= 2 else None
         if me == 0:
             out = [int(v) for v in values]
             for p in range(1, self.process_count):
@@ -216,13 +222,14 @@ class Runtime:
             client.key_value_set(
                 f"psbkt/{tag}/max", ",".join(str(v) for v in out)
             )
-            if dead is not None:
-                client.key_value_delete(f"psbkt/{dead}/max")
+            prev = self._cp_state.get("prev_tag")
+            if prev is not None:
+                client.key_value_delete(f"psbkt/{prev}/max")
+            self._cp_state["prev_tag"] = tag
             return tuple(out)
         client.key_value_set(f"psbkt/{tag}/{me}", enc)
         got = client.blocking_key_value_get(f"psbkt/{tag}/max", timeout_ms)
-        if dead is not None:
-            client.key_value_delete(f"psbkt/{dead}/{me}")
+        client.key_value_delete(f"psbkt/{tag}/{me}")
         return tuple(int(v) for v in got.split(","))
 
     def barrier(self, name: str = "") -> None:

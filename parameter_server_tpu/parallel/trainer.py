@@ -182,9 +182,36 @@ class PodTrainer:
         # namespaces; epochs within a trainer get their own sub-counter
         self._bucket_ns = f"t{next(_TRAINER_SEQ)}"
         self._epoch_seq = itertools.count()
-        if self._bucket_sync and cfg.solver.max_delay > 0:
-            probe = self.runtime.cp_allmax(f"{self._bucket_ns}probe/0", (0,))
-            if probe is None:
+        if self._bucket_sync:
+            # the probe doubles as a fail-fast check of the namespacing
+            # contract: _TRAINER_SEQ only yields pod-agreed namespaces when
+            # every process constructs its PodTrainers in the same order.
+            # An asymmetric construction makes the probe tags disagree, so
+            # the blocking get would time out — surface that as a clear
+            # contract error within the startup-grace window, not a
+            # 10-minute silent hang on the first training step. The window
+            # is bounded below (120s) so ordinary cross-process startup
+            # skew (slow checkpoint load on one host) isn't misdiagnosed.
+            grace_ms = int(
+                max(120.0, cfg.fault.startup_grace_s * 2) * 1000
+            )
+            try:
+                probe = self.runtime.cp_allmax(
+                    f"{self._bucket_ns}probe/0", (0,), timeout_ms=grace_ms
+                )
+            except Exception as e:
+                raise RuntimeError(
+                    f"pod bucket-agreement probe for trainer namespace "
+                    f"{self._bucket_ns!r} failed ({e!r}). If the other "
+                    "processes are alive, the likely cause is processes "
+                    "constructing PodTrainers in different orders (the KV "
+                    "namespacing contract) — make every process build the "
+                    "same trainers in the same sequence. A process that is "
+                    f"merely >{grace_ms // 1000}s slower to construct its "
+                    "trainer also trips this; raise fault.startup_grace_s "
+                    "if that is legitimate in your deployment"
+                ) from e
+            if probe is None and cfg.solver.max_delay > 0:
                 print(
                     "[pod] note: no control-plane KV — multi-host "
                     "bucket_nnz agreement falls back to a device "
@@ -678,7 +705,7 @@ class PodTrainer:
             pending.append(
                 (probs_dev, [b.labels[: b.num_examples] for b in group])
             )
-            if len(pending) > _EVAL_INFLIGHT:
+            if len(pending) >= _EVAL_INFLIGHT:
                 _retire_oldest()
 
         group: list[CSRBatch] = []
