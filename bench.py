@@ -802,10 +802,9 @@ def _run_child(name: str, env: dict, timeout_s: float) -> dict:
             [sys.executable, os.path.abspath(__file__), "--child", name],
             stdout=fout, stderr=ferr, env=env, start_new_session=True,
         )
-        deadline = t0 + timeout_s
-        while proc.poll() is None and time.perf_counter() < deadline:
-            time.sleep(0.25)
-        if proc.poll() is None:
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
             except OSError:
@@ -832,12 +831,16 @@ def _newest_tpu_capture() -> str | None:
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
-    caps = glob.glob(os.path.join(here, "BENCH_r*_local.json"))
+    caps = [
+        (m, p)
+        for p in glob.glob(os.path.join(here, "BENCH_r*_local.json"))
+        if (m := re.search(r"r(\d+)", os.path.basename(p)))
+    ]
     if not caps:
         return None
     # numeric round sort: lexicographic would rank r9 above r10
-    caps.sort(key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
-    return os.path.basename(caps[-1])
+    caps.sort(key=lambda mp: int(mp[0].group(1)))
+    return os.path.basename(caps[-1][1])
 
 
 def main() -> None:
@@ -866,12 +869,15 @@ def main() -> None:
                 degraded = True
                 results[name]["degraded_after"] = True
                 if name == "headline":
+                    orig_err = results[name].get("error", "")
                     results[name] = _run_child(
                         "headline", env, CHILD_BUDGET_S["headline"]
                     )
                     results[name]["platform"] = (
                         "cpu (fallback: accelerator unreachable)"
                     )
+                    # keep the wedge diagnostics from the TPU attempt
+                    results[name]["tpu_attempt_error"] = orig_err[-300:]
 
     head = results.get("headline", {})
     if "error" in head:  # headline died even after fallback: contract floor

@@ -110,7 +110,8 @@ def _build_parser() -> argparse.ArgumentParser:
 
 
 _KNOWN_APPS = (
-    "linear_method", "graph_partition", "sketch", "matrix_fac", "word2vec"
+    "linear_method", "graph_partition", "sketch", "matrix_fac", "word2vec",
+    "wide_deep",
 )
 
 
@@ -154,6 +155,8 @@ def run_train(cfg: PSConfig, args: argparse.Namespace) -> dict:
         return _run_train_mf(cfg, args)
     if cfg.app == "word2vec":
         return _run_train_w2v(cfg, args)
+    if cfg.app == "wide_deep":
+        return _run_train_wd(cfg, args)
     if cfg.solver.algo == "darlin":
         from parameter_server_tpu.data.batch import BatchBuilder
         from parameter_server_tpu.data.reader import MinibatchReader
@@ -383,6 +386,32 @@ def _run_train_w2v(cfg: PSConfig, args: argparse.Namespace) -> dict:
     return out
 
 
+def _run_train_wd(cfg: PSConfig, args: argparse.Namespace) -> dict:
+    """wide_deep app dispatch (ref: App::Create on the W&D CTR config;
+    BASELINE parity config "Wide-&-Deep CTR ... server-sharded
+    embeddings"): streaming file-driven train over the same text formats
+    as linear_method, optional (data, kv) mesh via [parallel]."""
+    from parameter_server_tpu.data.batch import eval_builder, training_builder
+    from parameter_server_tpu.models.wide_deep import WideDeep
+
+    app = WideDeep.from_config(cfg, mesh=_mesh_from_cfg(cfg))
+    last = app.train_files(
+        cfg.data.files, cfg.data.format, training_builder(cfg),
+        epochs=max(1, cfg.solver.epochs),
+        report_every=args.report_interval,
+    )
+    out = dict(last or {})
+    out.update({"emb_dim": cfg.wd.emb_dim, "hidden": list(cfg.wd.hidden)})
+    if cfg.data.val_files:
+        ev = app.evaluate_files(
+            cfg.data.val_files, cfg.data.format, eval_builder(cfg)
+        )
+        out.update({f"val_{k}": v for k, v in ev.items()})
+    if args.model_out:
+        out["model_out"] = app.dump_model(args.model_out)
+    return out
+
+
 def run_convert(cfg: PSConfig, args: argparse.Namespace) -> dict:
     """Offline conversion (ref: the text2proto tool + SlotReader's
     parse-once cache): parse the config's text files once and populate the
@@ -429,6 +458,15 @@ def run_evaluate(cfg: PSConfig, args: argparse.Namespace) -> dict:
     files = args.data if args.data else (cfg.data.val_files or cfg.data.files)
     if not files:
         raise SystemExit("no evaluation files (config val_files/files or --data)")
+    if cfg.app == "wide_deep":
+        # the W&D dump is an npz (wide + embedding + MLP), not the linear
+        # apps' flat text vector
+        from parameter_server_tpu.data.batch import eval_builder
+        from parameter_server_tpu.models.wide_deep import evaluate_dump
+
+        return evaluate_dump(
+            args.model, files, cfg.data.format, eval_builder(cfg)
+        )
     return evaluate_model(
         args.model,
         files,

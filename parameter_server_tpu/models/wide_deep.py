@@ -205,7 +205,14 @@ def _make_wd_spmd(
     )
 
     if push_mode not in ("per_worker", "aggregate"):
-        raise ValueError(f"unknown push_mode {push_mode!r}")
+        # "quantized" is a known framework-wide mode (parallel/spmd.py)
+        # but is not implemented for the W&D dual-table push — say so
+        # instead of calling a schema-valid value unknown
+        raise ValueError(
+            f"wide_deep supports push_mode 'per_worker' or 'aggregate'; "
+            f"got {push_mode!r} (int8-quantized push is not implemented "
+            "for the W&D dual-table step)"
+        )
     shard_size = _shard_size(num_keys, mesh.shape["kv"])
 
     def micro(wide_l, emb_l, mlp_params, opt_state, b):
@@ -342,6 +349,9 @@ class WideDeep:
         seed: int = 0,
         reporter: ProgressReporter | None = None,
         steps_per_call: int = 1,
+        mesh=None,
+        push_mode: str = "per_worker",
+        max_delay: int = 0,
     ):
         self.num_keys = num_keys
         self.reporter = reporter or ProgressReporter()
@@ -352,6 +362,8 @@ class WideDeep:
         if steps_per_call < 1:
             raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
         self.steps_per_call = steps_per_call
+        self.hidden = list(hidden or [32, 16])
+        self.emb_dim = emb_dim
         self.wide_up = Ftrl(**(ftrl_kw or {"alpha": 0.1, "lambda_l1": 0.5}))
         self.emb_up = Adagrad(eta=emb_eta)
         self.wide_state = self.wide_up.init(num_keys, 1)
@@ -360,92 +372,228 @@ class WideDeep:
         init = rng.normal(scale=0.05, size=(num_keys, emb_dim)).astype(np.float32)
         init[0] = 0.0
         self.emb_state["w"] = jnp.asarray(init)
-        self.mlp_params = init_mlp(emb_dim, hidden or [32, 16], seed=seed)
+        self.mlp_params = init_mlp(emb_dim, self.hidden, seed=seed)
         self.opt = optax.adam(mlp_lr)
         self.opt_state = self.opt.init(self.mlp_params)
         self.examples_seen = 0
+        self.mesh = mesh
+        self.max_delay = max_delay  # SSP dispatch bound (ref: wait_time)
+        if mesh is not None:
+            from parameter_server_tpu.parallel.spmd import shard_state
+
+            maker = (
+                make_wd_spmd_train_multistep
+                if steps_per_call > 1
+                else make_wd_spmd_train_step
+            )
+            self._spmd_step = maker(
+                self.wide_up, self.emb_up, self.opt, mesh, num_keys,
+                push_mode=push_mode,
+            )
+            self.wide_state = shard_state(self.wide_state, mesh)
+            self.emb_state = shard_state(self.emb_state, mesh)
+
+    @classmethod
+    def from_config(cls, cfg, mesh=None, reporter=None) -> "WideDeep":
+        """Build the app from a PSConfig (ref: App::Create on the W&D
+        config): wide half from [lr]/[penalty] FTRL fields, deep half from
+        the [wd] section, dispatch shape from [solver]/[parallel]."""
+        return cls(
+            num_keys=cfg.data.num_keys,
+            emb_dim=cfg.wd.emb_dim,
+            hidden=list(cfg.wd.hidden),
+            ftrl_kw=dict(
+                alpha=cfg.lr.alpha, beta=cfg.lr.beta,
+                lambda_l1=cfg.penalty.lambda_l1,
+                lambda_l2=cfg.penalty.lambda_l2,
+            ),
+            emb_eta=cfg.wd.emb_eta,
+            mlp_lr=cfg.wd.mlp_lr,
+            seed=cfg.seed,
+            reporter=reporter,
+            steps_per_call=cfg.solver.steps_per_call,
+            mesh=mesh,
+            push_mode=cfg.parallel.push_mode,
+            max_delay=max(cfg.solver.max_delay, 0),
+        )
+
+    def _dispatch(self, chunk: list[CSRBatch]):
+        """Issue ONE device call on up to D*K batches (padded with inert
+        batches to the static shape); returns (loss_dev, probs_dev,
+        metas) where metas aligns (k, d) -> (num_examples, labels)."""
+        from parameter_server_tpu.data.batch import pad_group
+
+        K = self.steps_per_call
+        D = self.mesh.shape["data"] if self.mesh is not None else 1
+        full = chunk + [_inert_like(chunk[0]) for _ in range(D * K - len(chunk))]
+        metas = [
+            [
+                (full[k * D + d].num_examples,
+                 full[k * D + d].labels[: full[k * D + d].num_examples])
+                for d in range(D)
+            ]
+            for k in range(K)
+        ]
+        if self.mesh is not None:
+            from parameter_server_tpu.parallel.spmd import (
+                place_stacked,
+                stack_batches,
+                stack_step_groups,
+            )
+
+            # W&D consumes the full wire format (row_ids)
+            stacks = [
+                stack_batches(pad_group(full[k * D : (k + 1) * D]), None)
+                for k in range(K)
+            ]
+            dev = place_stacked(
+                stacks[0] if K == 1 else stack_step_groups(stacks), self.mesh
+            )
+            (
+                self.wide_state, self.emb_state, self.mlp_params,
+                self.opt_state, loss, probs,
+            ) = self._spmd_step(
+                self.wide_state, self.emb_state, self.mlp_params,
+                self.opt_state, dev,
+            )
+            return loss, probs, metas
+        if K == 1:
+            (
+                self.wide_state, self.emb_state, self.mlp_params,
+                self.opt_state, loss, probs,
+            ) = wd_train_step(
+                self.wide_up, self.emb_up, self.opt,
+                self.wide_state, self.emb_state, self.mlp_params,
+                self.opt_state, batch_to_device(chunk[0]),
+            )
+            return loss, probs, metas
+        from parameter_server_tpu.parallel.spmd import (
+            CSR_FULL_FIELDS,
+            stack_fields,
+        )
+
+        stacked = stack_fields(pad_group(full), CSR_FULL_FIELDS, None)
+        dev = {k: jnp.asarray(v) for k, v in stacked.items()}
+        (
+            self.wide_state, self.emb_state, self.mlp_params,
+            self.opt_state, loss, probs,
+        ) = wd_train_multistep(
+            self.wide_up, self.emb_up, self.opt,
+            self.wide_state, self.emb_state, self.mlp_params,
+            self.opt_state, dev,
+        )
+        return loss, probs, metas
 
     def train(self, batches: Iterable[CSRBatch], report_every: int = 100) -> dict:
         """Train over a CSRBatch stream. With steps_per_call = K > 1,
-        groups of K batches are padded to one static shape and scanned in
-        a single device call (report_every counts device calls)."""
+        groups of K batches are scanned in a single device call; with a
+        mesh, each microstep consumes D batches (one per data shard).
+        Dispatch is SSP-gated (max_delay device calls in flight; losses
+        and probs are read back only on retirement — the DispatchWindow
+        pattern every trainer here shares). report_every counts device
+        calls."""
+        import itertools
+
+        from parameter_server_tpu.parallel.ssp import DispatchWindow
+
         window_p, window_y, losses = [], [], []
         n_since = 0
         t0 = time.perf_counter()
         last: dict = {}
         K = self.steps_per_call
+        D = self.mesh.shape["data"] if self.mesh is not None else 1
+
+        def _retire(step: int, entry) -> None:
+            loss_arr, probs_dev, metas = entry
+            losses.append(float(np.sum(np.asarray(loss_arr))))
+            p = np.asarray(probs_dev)
+            # normalize (B,) | (K,B) | (D,B) | (D,K,B) -> (D, K, B)
+            if self.mesh is None:
+                p = p.reshape(K, 1, -1).swapaxes(0, 1) if K > 1 else p[None, None]
+            elif K == 1:
+                p = p[:, None]
+            for k in range(K):
+                for d in range(D):
+                    n_ex, lab = metas[k][d]
+                    if n_ex:
+                        window_p.append(p[d, k, :n_ex])
+                        window_y.append(lab)
+
+        gate = DispatchWindow(self.max_delay, _retire)
         it = iter(batches)
         call_i = 0
         while True:
-            group = []
-            for _ in range(K):
-                b = next(it, None)
-                if b is None:
-                    break
-                group.append(b)
-            if not group:
+            chunk = list(itertools.islice(it, D * K))
+            if not chunk:
                 break
-            if K == 1:
-                (
-                    self.wide_state, self.emb_state, self.mlp_params,
-                    self.opt_state, loss, probs,
-                ) = wd_train_step(
-                    self.wide_up, self.emb_up, self.opt,
-                    self.wide_state, self.emb_state, self.mlp_params,
-                    self.opt_state, batch_to_device(group[0]),
-                )
-                losses.append(loss)
-                window_p.append((probs, group[0].num_examples))
-                window_y.append(group[0].labels[: group[0].num_examples])
-            else:
-                from parameter_server_tpu.data.batch import pad_group
-                from parameter_server_tpu.parallel.spmd import (
-                    CSR_FULL_FIELDS,
-                    stack_fields,
-                )
-
-                padded = pad_group(group + [
-                    _inert_like(group[0]) for _ in range(K - len(group))
-                ])
-                stacked = stack_fields(padded, CSR_FULL_FIELDS, None)
-                dev = {k: jnp.asarray(v) for k, v in stacked.items()}
-                (
-                    self.wide_state, self.emb_state, self.mlp_params,
-                    self.opt_state, loss_k, probs_k,
-                ) = wd_train_multistep(
-                    self.wide_up, self.emb_up, self.opt,
-                    self.wide_state, self.emb_state, self.mlp_params,
-                    self.opt_state, dev,
-                )
-                losses.append(loss_k)  # (K,) — _flush sums arrays too
-                for k, b in enumerate(group):
-                    window_p.append((probs_k[k], b.num_examples))
-                    window_y.append(b.labels[: b.num_examples])
-            n_group = sum(b.num_examples for b in group)
+            gate.gate(call_i)
+            loss, probs, metas = self._dispatch(chunk)
+            gate.add(call_i, (loss, probs, metas))
+            n_group = sum(b.num_examples for b in chunk)
             self.examples_seen += n_group
             n_since += n_group
             call_i += 1
             if call_i % report_every == 0:
+                gate.drain()
                 last = self._flush(losses, window_p, window_y, n_since, t0)
                 losses, window_p, window_y = [], [], []
                 n_since, t0 = 0, time.perf_counter()
+        gate.drain()
         if n_since:
             last = self._flush(losses, window_p, window_y, n_since, t0)
         return last
 
-    def _flush(self, losses, window_p, window_y, n_since, t0):
-        loss_sum = float(
-            sum(
-                float(np.sum(np.asarray(x)))
-                for x in jax.device_get(losses)
+    def train_files(
+        self,
+        files: list[str],
+        fmt: str,
+        builder,
+        epochs: int = 1,
+        report_every: int = 100,
+    ) -> dict:
+        """Streaming file-driven training (ref: the SGD worker's
+        MinibatchReader loop): parse -> localize -> W&D step per epoch."""
+        from parameter_server_tpu.data.reader import MinibatchReader
+
+        last: dict = {}
+        for _ in range(max(1, epochs)):
+            last = (
+                self.train(
+                    MinibatchReader(files, fmt, builder),
+                    report_every=report_every,
+                )
+                or last
             )
-        )
-        p = np.concatenate([np.asarray(pr)[:n] for pr, n in window_p])
-        y = np.concatenate(window_y)
+        return last
+
+    def evaluate_files(self, files: list[str], fmt: str, builder) -> dict:
+        from parameter_server_tpu.data.reader import MinibatchReader
+
+        return self.evaluate(MinibatchReader(files, fmt, builder))
+
+    def dump_model(self, path: str) -> str:
+        """Dump inference weights (npz): derived wide weights, embedding
+        table, MLP layers (ref: the text model dump each server range
+        writes; one npz here since the deep half isn't a flat vector)."""
+        host = {
+            k: np.asarray(v)
+            for k, v in (("wide_w", self.wide_up.weights(self.wide_state)),
+                         ("emb_w", self.emb_up.weights(self.emb_state)))
+        }
+        for i, layer in enumerate(self.mlp_params):
+            host[f"mlp_W{i}"] = np.asarray(layer["W"])
+            host[f"mlp_b{i}"] = np.asarray(layer["b"])
+        np.savez(path, **host)
+        return path
+
+    def _flush(self, losses, window_p, window_y, n_since, t0):
+        loss_sum = float(sum(losses))
+        p = np.concatenate(window_p) if window_p else np.zeros(0)
+        y = np.concatenate(window_y) if window_y else np.zeros(0)
         return self.reporter.report(
             examples=self.examples_seen,
             objv=loss_sum / max(n_since, 1),
-            auc=M.auc(y, p),
+            auc=M.auc(y, p) if len(y) else float("nan"),
             ex_per_sec=n_since / max(time.perf_counter() - t0, 1e-9),
         )
 
@@ -469,3 +617,41 @@ class WideDeep:
     def evaluate(self, batches: Iterable[CSRBatch]) -> dict:
         y, p = self.predict(batches)
         return {"auc": M.auc(y, p), "logloss": M.logloss(y, p), "examples": len(y)}
+
+
+def evaluate_dump(
+    model_path: str,
+    files: list[str],
+    fmt: str,
+    builder,
+) -> dict:
+    """Evaluate a ``WideDeep.dump_model`` npz over files (the CLI
+    ``evaluate`` path for app wide_deep; ref: the offline model evaluator
+    reading each server range's dump)."""
+    from parameter_server_tpu.data.reader import MinibatchReader
+
+    d = np.load(model_path)
+    wide_w = jnp.asarray(d["wide_w"])
+    emb_w = jnp.asarray(d["emb_w"])
+    mlp = []
+    i = 0
+    while f"mlp_W{i}" in d:
+        mlp.append(
+            {"W": jnp.asarray(d[f"mlp_W{i}"]), "b": jnp.asarray(d[f"mlp_b{i}"])}
+        )
+        i += 1
+    ys, ps = [], []
+    for b in MinibatchReader(files, fmt, builder):
+        dev = batch_to_device(b)
+        idx = dev["unique_keys"]
+        _, logits = _forward(
+            jnp.take(wide_w, idx, axis=0),
+            jnp.take(emb_w, idx, axis=0),
+            mlp,
+            dev,
+        )
+        ps.append(np.asarray(jax.nn.sigmoid(logits))[: b.num_examples])
+        ys.append(b.labels[: b.num_examples])
+    y = np.concatenate(ys)
+    p = np.concatenate(ps)
+    return {"auc": M.auc(y, p), "logloss": M.logloss(y, p), "examples": len(y)}

@@ -140,6 +140,19 @@ class W2VConfig:
 
 
 @dataclass
+class WDConfig:
+    """wide_deep app settings (ref: BASELINE's "Wide-&-Deep CTR with
+    100M-row embedding table" parity config). The wide half reuses the
+    [lr]/[penalty] FTRL hyperparameters; fields here shape the deep half.
+    data.files = criteo/libsvm/adfea text like the linear app."""
+
+    emb_dim: int = 16
+    hidden: list[int] = field(default_factory=lambda: [32, 16])
+    emb_eta: float = 0.05  # AdaGrad step for the embedding table
+    mlp_lr: float = 1e-3  # Adam step for the dense MLP
+
+
+@dataclass
 class SketchConfig:
     """sketch app settings (ref: the sketch App — distributed count-min)."""
 
@@ -205,6 +218,7 @@ class PSConfig:
     sketch: SketchConfig = field(default_factory=SketchConfig)
     mf: MFConfig = field(default_factory=MFConfig)
     w2v: W2VConfig = field(default_factory=W2VConfig)
+    wd: WDConfig = field(default_factory=WDConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
     model_output: str = ""
@@ -245,6 +259,7 @@ _NESTED = {
     "sketch": SketchConfig,
     "mf": MFConfig,
     "w2v": W2VConfig,
+    "wd": WDConfig,
     "parallel": ParallelConfig,
     "fault": FaultConfig,
 }
