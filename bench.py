@@ -1,7 +1,11 @@
 """Benchmark suite: flagship sparse-LR FTRL throughput + sub-benches.
 
-Prints ONE JSON line. Headline fields (driver contract):
-  {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N}
+Prints ONE COMPACT JSON line (< 1500 chars — the driver records only a
+2000-char stdout tail, so the contract fields must fit it) and writes the
+FULL nested result to BENCH_full_latest.json next to this file
+(override with PS_BENCH_FULL_OUT). Contract fields on the stdout line:
+  {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N,
+   "platform": ..., "suite_wall_s": N, "full_results": <filename>}
 
 value       — steady-state training examples/sec of the fused device step
               (pull -> CSR grad -> FTRL push), median of 3 timed passes.
@@ -876,13 +880,27 @@ def main() -> None:
                     results[name]["platform"] = (
                         "cpu (fallback: accelerator unreachable)"
                     )
-                    # keep the wedge diagnostics from the TPU attempt
+                    # keep the wedge diagnostics from the TPU attempt —
+                    # re-set AFTER the retry replaced the dict
+                    results[name]["degraded_after"] = True
                     results[name]["tpu_attempt_error"] = orig_err[-300:]
 
     head = results.get("headline", {})
     if "error" in head:  # headline died even after fallback: contract floor
-        head = {"platform": platform, "value": 0.0, "vs_baseline": 0.0,
-                "raw": {"error": head["error"]}}
+        # label the platform from the CURRENT degraded state, not the
+        # initial probe — a post-probe wedge means the number (0.0) came
+        # from the CPU fallback attempt, not the accelerator
+        floor_platform = (
+            "cpu (fallback: accelerator unreachable)" if degraded
+            else platform
+        )
+        # the wedge diagnostics ride in raw: it's the only headline field
+        # the full/compact emitters carry through
+        head = {"platform": floor_platform, "value": 0.0, "vs_baseline": 0.0,
+                "raw": {"error": head["error"],
+                        **{k: head[k]
+                           for k in ("degraded_after", "tpu_attempt_error")
+                           if k in head}}}
     top_platform = head.get("platform", platform)
     if degraded and "tpu" not in str(top_platform):
         top_platform = "cpu (fallback: accelerator unreachable)"
@@ -893,30 +911,90 @@ def main() -> None:
             # the tunnel can wedge for a whole session; the most recent
             # REAL-hardware capture is committed in-repo for the record
             extra["last_tpu_capture"] = cap
-    print(
-        json.dumps(
-            {
-                "metric": "sparse_lr_ftrl_train_throughput",
-                "value": head.get("value", 0.0),
-                "unit": "examples/sec",
-                "vs_baseline": head.get("vs_baseline", 0.0),
-                "platform": top_platform,
-                "raw": head.get("raw", {}),
-                "sub": {
-                    "pallas_ftrl": head.get("pallas_ftrl", {}),
-                    "pipeline_e2e": results.get("pipeline_e2e", {}),
-                    "ladder": results.get("ladder", {}),
-                    "hbm_scale": results.get("hbm_scale", {}),
-                    "word2vec": results.get("word2vec", {}),
-                    "matrix_fac": results.get("matrix_fac", {}),
-                    "spmd_push": results.get("spmd_push", {}),
-                    "ingest": results.get("ingest", {}),
-                },
-                "suite_wall_s": round(time.perf_counter() - t_start, 1),
-                **extra,
-            }
-        )
+
+    full = {
+        "metric": "sparse_lr_ftrl_train_throughput",
+        "value": head.get("value", 0.0),
+        "unit": "examples/sec",
+        "vs_baseline": head.get("vs_baseline", 0.0),
+        "platform": top_platform,
+        "raw": head.get("raw", {}),
+        "sub": {
+            "pallas_ftrl": head.get("pallas_ftrl", {}),
+            "pipeline_e2e": results.get("pipeline_e2e", {}),
+            "ladder": results.get("ladder", {}),
+            "hbm_scale": results.get("hbm_scale", {}),
+            "word2vec": results.get("word2vec", {}),
+            "matrix_fac": results.get("matrix_fac", {}),
+            "spmd_push": results.get("spmd_push", {}),
+            "ingest": results.get("ingest", {}),
+        },
+        "suite_wall_s": round(time.perf_counter() - t_start, 1),
+        **extra,
+    }
+    # FULL nested result goes to a file (committable as the round's
+    # capture); stdout gets ONE compact line. The driver records only a
+    # 2000-char stdout tail — round 4's full-result line overflowed it and
+    # truncated the contract fields away (VERDICT r4 missing #1).
+    out_path = os.environ.get(
+        "PS_BENCH_FULL_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_full_latest.json"),
     )
+    try:
+        with open(out_path, "w") as f:
+            json.dump(full, f, indent=1)
+        full_ref = os.path.basename(out_path)
+    except OSError:
+        full_ref = "unwritable"
+    print(json.dumps(_compact_contract(full, full_ref)))
+
+
+def _compact_contract(full: dict, full_ref: str) -> dict:
+    """One-scalar-per-sub-bench summary of the full result, guaranteed to
+    serialize < 1500 chars so the driver's stdout-tail buffer keeps the
+    contract fields intact whatever else the suite printed."""
+
+    def _pick(sub: str, *keys: str) -> dict:
+        d = full["sub"].get(sub) or {}
+        if "error" in d:
+            return {"error": str(d["error"])[-80:]}
+        return {k: d[k] for k in keys if k in d}
+
+    compact = {
+        "metric": full["metric"],
+        "value": full["value"],
+        "unit": full["unit"],
+        "vs_baseline": full["vs_baseline"],
+        "platform": full["platform"],
+        "suite_wall_s": full["suite_wall_s"],
+        "full_results": full_ref,
+        "sub": {
+            "pallas_ftrl": _pick(
+                "pallas_ftrl", "pallas_speedup",
+                "interpret_matches_jnp", "mode"),
+            "e2e": _pick(
+                "pipeline_e2e", "pipelined_k8_ex_per_sec", "auc_k8",
+                "fastest"),
+            "ladder": _pick("ladder", "bucketing_speedup", "k8_over_k1"),
+            "hbm": _pick(
+                "hbm_scale", "num_keys_log2", "sparse_step_ex_per_sec",
+                "dense_hbm_gb_per_sec"),
+            "w2v": _pick("word2vec", "pairs_per_sec_k8", "vs_baseline"),
+            "mf": _pick("matrix_fac", "pairs_per_sec_k8", "vs_baseline"),
+            "spmd": _pick("spmd_push", "aggregate_speedup"),
+            "ingest": _pick(
+                "ingest", "parse_mb_per_sec", "parse_build_ex_per_sec"),
+        },
+    }
+    if "last_tpu_capture" in full:
+        compact["last_tpu_capture"] = full["last_tpu_capture"]
+    if "error" in full.get("raw", {}):
+        compact["error"] = str(full["raw"]["error"])[-120:]
+    # belt and braces: the contract fields must survive the tail buffer
+    if len(json.dumps(compact)) > 1400:
+        compact.pop("sub", None)
+    return compact
 
 
 if __name__ == "__main__":

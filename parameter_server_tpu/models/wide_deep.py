@@ -196,26 +196,23 @@ def _make_wd_spmd(
     from jax.sharding import PartitionSpec as P
 
     from parameter_server_tpu.parallel.spmd import (
+        PUSH_MODES,
         _local_pull,
         _local_push,
         _local_push_aggregate,
+        _local_push_quantized,
         _shard_size,
         batch_spec,
         state_spec,
     )
 
-    if push_mode not in ("per_worker", "aggregate"):
-        # "quantized" is a known framework-wide mode (parallel/spmd.py)
-        # but is not implemented for the W&D dual-table push — say so
-        # instead of calling a schema-valid value unknown
+    if push_mode not in PUSH_MODES:
         raise ValueError(
-            f"wide_deep supports push_mode 'per_worker' or 'aggregate'; "
-            f"got {push_mode!r} (int8-quantized push is not implemented "
-            "for the W&D dual-table step)"
+            f"unknown push_mode {push_mode!r}; known: {PUSH_MODES}"
         )
     shard_size = _shard_size(num_keys, mesh.shape["kv"])
 
-    def micro(wide_l, emb_l, mlp_params, opt_state, b):
+    def micro(wide_l, emb_l, mlp_params, opt_state, b, seed):
         idx = b["unique_keys"]
         w_u = lax.psum(_local_pull(wide_up, wide_l, idx, shard_size), "kv")
         e_u = lax.psum(_local_pull(emb_up, emb_l, idx, shard_size), "kv")
@@ -228,6 +225,18 @@ def _make_wd_spmd(
             )
             new_emb = _local_push_aggregate(
                 emb_up, emb_l, idx, g_emb, shard_size
+            )
+        elif push_mode == "quantized":
+            # int8 stochastic-rounding push on BOTH tables — the embedding
+            # push is this app's dominant traffic (see make_wd_spmd_train_
+            # step), so it's the table where the 4x wire shrink pays most.
+            # Distinct streams decorrelate the two tables' rounding noise
+            # under the shared per-microstep seed.
+            new_wide = _local_push_quantized(
+                wide_up, wide_l, idx, g_wide, shard_size, seed, stream=1
+            )
+            new_emb = _local_push_quantized(
+                emb_up, emb_l, idx, g_emb, shard_size, seed, stream=2
             )
         else:
             all_idx = lax.all_gather(idx, "data")
@@ -250,33 +259,56 @@ def _make_wd_spmd(
         probs = jax.nn.sigmoid(logits)
         return new_wide, new_emb, new_mlp, new_opt_state, loss_sum, probs
 
-    def local_step(wide_l, emb_l, mlp_params, opt_state, batch):
+    def local_step(wide_l, emb_l, mlp_params, opt_state, batch, push_seed):
         b = {k: v[0] for k, v in batch.items()}
         if not multistep:
-            out = micro(wide_l, emb_l, mlp_params, opt_state, b)
+            out = micro(wide_l, emb_l, mlp_params, opt_state, b, push_seed)
             return (*out[:5], out[5][None, :])  # probs -> (D, B)
 
-        def body(carry, mb):  # b fields carry a leading (K_steps, ...) axis
-            out = micro(*carry, mb)
+        def body(carry, xs):  # b fields carry a leading (K_steps, ...) axis
+            mb, i = xs
+            # quantized mode: a distinct PRNG key per microstep (same
+            # contract as parallel.spmd.make_spmd_train_multistep)
+            out = micro(*carry, mb, push_seed + i)
             return tuple(out[:4]), (out[4], out[5])
 
+        n_micro = b["labels"].shape[0]
         carry = (wide_l, emb_l, mlp_params, opt_state)
-        (w, e, m, o), (losses, probs) = lax.scan(body, carry, b)
+        (w, e, m, o), (losses, probs) = lax.scan(
+            body, carry, (b, jnp.arange(n_micro, dtype=jnp.int32))
+        )
         return w, e, m, o, losses, probs[None]  # probs -> (D, K, B)
 
     step = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(state_spec(), state_spec(), P(), P(), batch_spec()),
+        in_specs=(state_spec(), state_spec(), P(), P(), batch_spec(), P()),
         out_specs=(state_spec(), state_spec(), P(), P(), P(), batch_spec()),
         check_vma=False,
     )
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def jitted(wide_state, emb_state, mlp_params, opt_state, batch):
-        return step(wide_state, emb_state, mlp_params, opt_state, batch)
+    def _jitted(wide_state, emb_state, mlp_params, opt_state, batch,
+                push_seed):
+        return step(wide_state, emb_state, mlp_params, opt_state, batch,
+                    jnp.int32(push_seed))
 
-    return jitted
+    def stepper(wide_state, emb_state, mlp_params, opt_state, batch,
+                push_seed=None):
+        if push_seed is None:
+            if push_mode == "quantized":
+                # same contract as parallel.spmd._wrap_stepper: a silently
+                # defaulted seed would reuse one PRNG key every step,
+                # correlating the rounding noise instead of averaging it
+                raise ValueError(
+                    "quantized push mode requires a per-call push_seed: "
+                    "call step(wide, emb, mlp, opt, batch, seed)"
+                )
+            push_seed = 0
+        return _jitted(wide_state, emb_state, mlp_params, opt_state, batch,
+                       push_seed)
+
+    return stepper
 
 
 def make_wd_spmd_train_step(
@@ -295,8 +327,12 @@ def make_wd_spmd_train_step(
     masked gather + psum over kv; push = all_gather over data + sequential
     per-worker updates on each kv shard — or, with push_mode "aggregate",
     one psum per table pre-sums the per-key grads and ONE updater step
-    applies them (parallel/spmd._local_push_aggregate; the embedding-table
-    push is this app's dominant traffic)."""
+    applies them (parallel/spmd._local_push_aggregate), or, with
+    "quantized", per_worker semantics with int8 stochastically-rounded
+    gradients on the wire for BOTH tables (the embedding-table push is
+    this app's dominant traffic, so it benefits most from the 4x shrink;
+    quantized mode requires a per-call push_seed — the WideDeep app
+    threads one automatically)."""
     return _make_wd_spmd(
         wide_up, emb_up, opt, mesh, num_keys, push_mode, multistep=False
     )
@@ -392,6 +428,10 @@ class WideDeep:
             )
             self.wide_state = shard_state(self.wide_state, mesh)
             self.emb_state = shard_state(self.emb_state, mesh)
+        self.push_mode = push_mode
+        # quantized push: each device call gets a fresh base seed (the
+        # scan folds +i per microstep), so rounding noise never repeats
+        self._push_calls = 0
 
     @classmethod
     def from_config(cls, cfg, mesh=None, reporter=None) -> "WideDeep":
@@ -454,8 +494,9 @@ class WideDeep:
                 self.opt_state, loss, probs,
             ) = self._spmd_step(
                 self.wide_state, self.emb_state, self.mlp_params,
-                self.opt_state, dev,
+                self.opt_state, dev, self._push_calls * K,
             )
+            self._push_calls += 1
             return loss, probs, metas
         if K == 1:
             (

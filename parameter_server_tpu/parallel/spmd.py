@@ -223,6 +223,7 @@ def _local_push_quantized(
     grad: jax.Array,  # (U, vdim)
     shard_size: int,
     push_seed: jax.Array,  # scalar int32, varies per step
+    stream: int = 0,  # static sub-stream tag (multi-table apps: one per table)
 ) -> State:
     """Per-worker push with int8-quantized gradients on the wire (the
     reference's fixing_float filter re-expressed as a quantized
@@ -231,10 +232,16 @@ def _local_push_quantized(
     rounding; the all_gather then moves 1 byte per value instead of 4 —
     the payload that dominates cross-slice DCN traffic. Dequantization
     happens after the gather, so server semantics stay exactly
-    ``_local_push`` (each worker's push is its own updater step)."""
+    ``_local_push`` (each worker's push is its own updater step).
+
+    ``stream`` decorrelates the rounding noise between pushes that share
+    one push_seed (Wide&Deep pushes two tables per microstep); 0 keeps
+    the original key schedule, so single-table trajectories are stable."""
     key = jax.random.fold_in(
         jax.random.key(push_seed), lax.axis_index("data")
     )
+    if stream:
+        key = jax.random.fold_in(key, stream)
     scale = jnp.max(jnp.abs(grad)) / 127.0 + 1e-30
     t = grad / scale
     floor = jnp.floor(t)

@@ -207,6 +207,43 @@ class TestWideDeepAggregatePush:
         assert losses[-1] < losses[0] * 0.3, losses[::8]
 
 
+class TestWideDeepQuantizedPush:
+    def test_quantized_tracks_per_worker_on_xor(self):
+        """int8 stochastic-rounding push on BOTH W&D tables (the embedding
+        push is the app's dominant traffic): the quantized trajectory must
+        reach the same XOR solution as per_worker — convergence parity,
+        not bitwise equality (the rounding noise is real). Runs through
+        the WideDeep app itself so the per-call seed threading and the
+        scanned per-microstep seed fold (steps_per_call=2) are what's
+        under test, not a hand-driven step."""
+        mesh = make_mesh(2, 2)
+        builder = BatchBuilder(num_keys=64, batch_size=256, key_mode="identity")
+        batches, _ = TestWideDeepSPMD()._xor_batches(builder)
+        aucs = {}
+        for mode in ("per_worker", "quantized"):
+            app = WideDeep(num_keys=64, emb_dim=8, hidden=[16], mlp_lr=5e-3,
+                           reporter=quiet(), mesh=mesh, push_mode=mode,
+                           steps_per_call=2)
+            for _ in range(40):
+                app.train(batches, report_every=10**6)
+            aucs[mode] = app.evaluate(batches)["auc"]
+        assert aucs["quantized"] > 0.9, aucs
+        assert abs(aucs["quantized"] - aucs["per_worker"]) < 0.05, aucs
+
+    def test_quantized_seed_advances_per_call(self):
+        """Two dispatches must not reuse one PRNG stream: the app's base
+        seed advances by K per device call (a silently-frozen seed would
+        correlate the rounding noise across steps instead of averaging
+        it out)."""
+        mesh = make_mesh(2, 2)
+        app = WideDeep(num_keys=64, emb_dim=8, hidden=[16], reporter=quiet(),
+                       mesh=mesh, push_mode="quantized", steps_per_call=2)
+        builder = BatchBuilder(num_keys=64, batch_size=256, key_mode="identity")
+        batches, _ = TestWideDeepSPMD()._xor_batches(builder, n=1024)
+        app.train(batches, report_every=10**6)
+        assert app._push_calls == len(batches) // (2 * 2)
+
+
 class TestWord2VecSPMD:
     @pytest.mark.parametrize("push_mode", ["per_worker", "aggregate"])
     def test_learns_structure_on_mesh(self, push_mode):
