@@ -50,6 +50,11 @@ from parameter_server_tpu.utils.metrics import ProgressReporter
 # PodTrainer._bucket_ns)
 _TRAINER_SEQ = itertools.count()
 
+# lower bound on the bucket-agreement probe window: real pods need room
+# for ordinary startup skew whatever fault.startup_grace_s says; tests
+# shrink it to exercise the timeout diagnostic without a 2-minute wait
+_PROBE_GRACE_FLOOR_S = 120.0
+
 # eval's bounded async-dispatch depth (see PodTrainer.evaluate_files):
 # enough to overlap host batch-build with device predict, small enough
 # that queued input/result buffers stay a constant HBM footprint
@@ -190,14 +195,22 @@ class PodTrainer:
             # the blocking get would time out — surface that as a clear
             # contract error within the startup-grace window, not a
             # 10-minute silent hang on the first training step. The window
-            # is bounded below (120s) so ordinary cross-process startup
-            # skew (slow checkpoint load on one host) isn't misdiagnosed.
+            # is bounded below (_PROBE_GRACE_FLOOR_S) so ordinary
+            # cross-process startup skew (slow checkpoint load on one
+            # host) isn't misdiagnosed, and the wait is 2x that window in
+            # ONE cp_allmax call: a transiently slow host then simply
+            # arrives mid-wait and the blocking get completes — a true
+            # rendezvous, where a retry under a fresh tag could never
+            # meet a peer still posting under the first tag (and a
+            # re-post under the SAME tag errors: set-once KV keys).
             grace_ms = int(
-                max(120.0, cfg.fault.startup_grace_s * 2) * 1000
+                max(_PROBE_GRACE_FLOOR_S, cfg.fault.startup_grace_s * 2)
+                * 1000
             )
             try:
                 probe = self.runtime.cp_allmax(
-                    f"{self._bucket_ns}probe/0", (0,), timeout_ms=grace_ms
+                    f"{self._bucket_ns}probe/0", (0,),
+                    timeout_ms=2 * grace_ms,
                 )
             except Exception as e:
                 raise RuntimeError(
@@ -206,10 +219,11 @@ class PodTrainer:
                     "processes are alive, the likely cause is processes "
                     "constructing PodTrainers in different orders (the KV "
                     "namespacing contract) — make every process build the "
-                    "same trainers in the same sequence. A process that is "
-                    f"merely >{grace_ms // 1000}s slower to construct its "
-                    "trainer also trips this; raise fault.startup_grace_s "
-                    "if that is legitimate in your deployment"
+                    "same trainers in the same sequence. A process that "
+                    f"is merely >{2 * grace_ms // 1000}s slower to "
+                    "construct its trainer also trips this; raise "
+                    "fault.startup_grace_s if that is legitimate in your "
+                    "deployment"
                 ) from e
             if probe is None and cfg.solver.max_delay > 0:
                 print(

@@ -358,3 +358,75 @@ class TestHashLocalize:
         np.testing.assert_array_equal(
             vals[:3], np.array([26.0, 0.125, 0.5], dtype=np.float32)
         )
+
+
+@pytest.mark.skipif(
+    not native.native_available(), reason="native parser failed to build"
+)
+class TestAdversarialFuzzParity:
+    """Randomized bit-parity sweep for the AVX2 structural parser: bare
+    keys (the exact-capacity retry path), empty values, CRLF + lone-CR
+    line ends, tab/multi-space separators, overlong digit runs, 19-digit
+    mantissa boundaries, exponents — every row must match the Python
+    parser bit-for-bit, through both parse_chunk and the streaming
+    iter_chunks wrapper (small chunk_bytes forces tail carries)."""
+
+    def _blob(self, n=1500, seed=7):
+        import random
+
+        rng = random.Random(seed)
+
+        def num():
+            c = rng.randrange(9)
+            if c == 0:
+                return str(rng.randint(0, 10 ** rng.randint(1, 25)))
+            if c == 1:
+                return f"{rng.uniform(-1e3, 1e3):.{rng.randint(0, 20)}f}"
+            if c == 2:
+                return f"{rng.uniform(-1e30, 1e30):.{rng.randint(0, 18)}e}"
+            if c == 3:
+                return "0" * rng.randint(1, 12) + str(rng.randint(0, 999999))
+            if c == 4:
+                return str(rng.randint(0, 9))
+            if c == 5:
+                return "12345678"
+            if c == 6:
+                return "1234567890123456789"
+            if c == 7:
+                return "9" * rng.randint(18, 26)
+            return f"{rng.uniform(0, 2):.6g}"
+
+        lines = []
+        for _ in range(n):
+            ents = []
+            for _ in range(rng.randint(1, 12)):
+                k = str(rng.randint(0, 10 ** rng.randint(1, 12)))
+                style = rng.randrange(4)
+                ents.append(k if style == 0 else
+                            k + ":" if style == 1 else f"{k}:{num()}")
+            sep = rng.choice([" ", "  ", " \t "])
+            lines.append(
+                rng.choice(["1", "-1", "0", "0.5", "-0.0001", "+1"])
+                + sep + sep.join(ents) + rng.choice(["\n", "\n", "\r\n"])
+            )
+        return "".join(lines).encode(), n
+
+    def test_bit_parity_with_python(self, tmp_path):
+        from parameter_server_tpu.data.libsvm import iter_libsvm
+
+        blob, n = self._blob()
+        labels, splits, keys, vals, _ = native.parse_chunk("libsvm", blob)
+        p = tmp_path / "fuzz.svm"
+        p.write_bytes(blob)
+        rows_py = list(iter_libsvm(p))
+        assert len(rows_py) == len(labels) == n
+        for i, (yl, kk, vv, _s) in enumerate(rows_py):
+            s, e = splits[i], splits[i + 1]
+            assert labels[i] == yl
+            assert np.array_equal(keys[s:e], kk)
+            assert np.array_equal(vals[s:e], vv), i
+        total = sum(
+            len(fl[0])
+            for fl in native.iter_chunks(p, "libsvm", chunk_bytes=1 << 14)
+        )
+        assert total == n
