@@ -43,6 +43,11 @@ Sub-benches ("sub"):
                  num_keys = 2^27 (1 GiB of z+n state on TPU): rows/sec,
                  effective HBM GB/s, and no-OOM at reference-shaped key
                  counts (SURVEY §7.4 huge key spaces).
+  scale        — sustained e2e: 10^7 examples (2.3 GB of libsvm text)
+                 streamed through parse -> frequency filter -> bucketing
+                 -> pipeline -> K=8 multistep vs a 2^24-key table, with
+                 held-out AUC (the Criteo-TB-shaped north star on a
+                 synthetic stand-in).
   word2vec     — fused-SGNS pairs/sec (BASELINE's second parity config),
                  K in {1, 8}, now with a single-core numpy SGNS baseline
                  on identical batch semantics (vs_baseline).
@@ -86,6 +91,7 @@ CHILD_BUDGET_S = {
     "pipeline_e2e": 480,
     "ladder": 480,
     "hbm_scale": 300,
+    "scale": 720,
     "word2vec": 360,
     "matrix_fac": 300,
     "spmd_push": 300,
@@ -94,7 +100,7 @@ CHILD_BUDGET_S = {
 # run order = value order: the contract fields land first, platform-bound
 # numbers next, platform-independent ones last
 CHILD_ORDER = (
-    "headline", "pipeline_e2e", "hbm_scale", "ladder", "word2vec",
+    "headline", "pipeline_e2e", "hbm_scale", "ladder", "scale", "word2vec",
     "matrix_fac", "spmd_push", "ingest",
 )
 
@@ -256,11 +262,75 @@ def bench_pallas_ftrl() -> dict:
             "interpret_matches_jnp": ok,
         }
     pallas_rows = _time(Ftrl(**kw, use_pallas=True))
-    return {
+    out = {
         "mode": "real",
         "jnp_rows_per_sec": round(jnp_rows, 1),
         "pallas_rows_per_sec": round(pallas_rows, 1),
         "pallas_speedup": round(pallas_rows / jnp_rows, 3),
+    }
+    # the fused gather->FTRL->scatter kernel vs the XLA composite push at
+    # 2^20 and 2^27 rows (VERDICT r4 #3: the one Pallas variant with a
+    # mechanism for winning — one HBM round trip per touched row instead
+    # of two). Guarded: a Mosaic compile failure records an error string
+    # instead of killing the capture.
+    for log2 in (20, 27):  # p20/p27 = 2^20 / 2^27 table rows
+        try:
+            out[f"fused_push_p{log2}"] = _bench_fused_push(log2)
+        except Exception as e:  # noqa: BLE001 — keep the capture alive
+            out[f"fused_push_p{log2}"] = {"error": repr(e)[-300:]}
+    return out
+
+
+def _bench_fused_push(rows_log2: int) -> dict:
+    """Touched-rows/sec of kv.store.push (gather + fused elementwise +
+    scatter-add) vs the fused Pallas kernel, both with donated state
+    (in-place tables, the steady-state training shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    from parameter_server_tpu.kv import store
+    from parameter_server_tpu.kv.updaters import Ftrl
+    from parameter_server_tpu.ops.pallas_kernels import ftrl_push_pallas
+
+    K = 1 << rows_log2
+    rng = np.random.default_rng(9)
+    idx = jnp.asarray(np.unique(rng.integers(1, K, 1 << 17)).astype(np.int32))
+    u = int(idx.shape[0])
+    g = jnp.asarray(rng.normal(size=(u, 1)).astype(np.float32))
+    up = Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2)
+    composite = jax.jit(
+        lambda st, i_, g_: store.push(up, st, i_, g_), donate_argnums=0
+    )
+    fused = lambda st, i_, g_: ftrl_push_pallas(  # noqa: E731
+        st, i_, g_, alpha=ALPHA, beta=BETA, l1=L1, l2=L2
+    )
+
+    def _rows_per_sec(f) -> float:
+        st = {
+            "z": jnp.zeros((K, 1), jnp.float32),
+            "n": jnp.zeros((K, 1), jnp.float32),
+        }
+        st = f(st, idx, g)
+        jax.block_until_ready(st["z"])  # compile
+        t0 = time.perf_counter()
+        st = f(st, idx, g)
+        jax.block_until_ready(st["z"])
+        probe = max(time.perf_counter() - t0, 1e-5)
+        iters = min(max(5, int(0.5 / probe)), 200)  # capped (tunnel stalls)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            st = f(st, idx, g)
+        jax.block_until_ready(st["z"])
+        return u * iters / (time.perf_counter() - t0)
+
+    comp = _rows_per_sec(composite)
+    fus = _rows_per_sec(fused)
+    return {
+        "rows_log2": rows_log2,
+        "touched_rows": u,
+        "composite_rows_per_sec": round(comp, 1),
+        "fused_rows_per_sec": round(fus, 1),
+        "fused_speedup": round(fus / comp, 3),
     }
 
 
@@ -492,6 +562,73 @@ def child_hbm_scale() -> dict:
     return out
 
 
+def child_scale() -> dict:
+    """Sustained-scale streaming e2e (the BASELINE north star is
+    Criteo-TB-shaped; zero egress => synthetic stand-in): 10^7 examples
+    through the FULL path — native parse -> count-min frequency
+    admission -> pow-2 nnz bucketing -> prefetch pipeline -> scanned K=8
+    multistep with SSP run-ahead — against a 2^24-key table, with
+    held-out AUC. One 57 MB shard is written once and streamed 40x
+    (page-cache resident: this measures the framework, not the disk)."""
+    from parameter_server_tpu.data.synthetic import (
+        make_sparse_logistic,
+        write_libsvm,
+    )
+    from parameter_server_tpu.parallel.trainer import PodTrainer
+    from parameter_server_tpu.utils.config import PSConfig
+    from parameter_server_tpu.utils.metrics import ProgressReporter
+
+    shard_n, repeats, test_n = 250_000, 40, 50_000
+    out: dict = {
+        "platform": _platform(),
+        "num_keys_log2": 24,
+        "examples_streamed": shard_n * repeats,
+    }
+    with tempfile.TemporaryDirectory() as d:
+        # ONE generation call: train shard and held-out slice share the
+        # same ground-truth weights (different seeds would mean a test
+        # set from a different true model — AUC 0.5 by construction)
+        labels, keys, vals, _ = make_sparse_logistic(
+            shard_n + test_n, 1 << 22, nnz_per_example=NNZ_PER, noise=0.4,
+            seed=31,
+        )
+        train_p = os.path.join(d, "shard.svm")
+        write_libsvm(
+            train_p, labels[:shard_n], keys[:shard_n], vals[:shard_n]
+        )
+        test_p = os.path.join(d, "test.svm")
+        write_libsvm(
+            test_p, labels[shard_n:], keys[shard_n:], vals[shard_n:]
+        )
+        out["shard_mb"] = round(os.path.getsize(train_p) / 1e6, 1)
+        out["gb_streamed"] = round(out["shard_mb"] * repeats / 1000, 2)
+        cfg = PSConfig()
+        cfg.data.num_keys = 1 << 24
+        cfg.data.pipeline_depth = 2
+        cfg.data.bucket_nnz = True
+        cfg.data.compact_wire = True
+        cfg.data.max_nnz_per_example = 4 * NNZ_PER
+        cfg.data.freq_min_count = 2
+        cfg.solver.minibatch = 8192
+        cfg.solver.steps_per_call = 8
+        cfg.solver.max_delay = 2
+        cfg.solver.epochs = 1
+        cfg.penalty.lambda_l1 = L1
+        t = PodTrainer(
+            cfg, reporter=ProgressReporter(print_fn=lambda *_: None)
+        )
+        t.train_files([train_p], report_every=200)  # compile warmup pass
+        t0 = time.perf_counter()
+        last = t.train_files([train_p] * repeats, report_every=200)
+        dt = time.perf_counter() - t0
+        out["ex_per_sec"] = round(shard_n * repeats / dt, 1)
+        out["wall_s_stream"] = round(dt, 1)
+        out["train_auc_tail"] = last.get("auc")
+        ev = t.evaluate_files([test_p])
+        out["holdout_auc"] = round(ev["auc"], 4)
+    return out
+
+
 def child_word2vec() -> dict:
     """word2vec SGNS throughput (BASELINE's second parity config) at
     steps_per_call 1 and 8, plus a single-core numpy SGNS baseline with
@@ -570,6 +707,10 @@ def child_word2vec() -> dict:
     out["baseline_pairs_per_sec"] = round(base, 1)
     out["baseline_runs"] = [round(r, 1) for r in runs]
     out["vs_baseline"] = round(out["pairs_per_sec_k8"] / base, 2)
+    # the device number includes host-side skip-gram pair generation that
+    # the numpy baseline is not charged for (it times only the SGNS math
+    # on pre-generated arrays) — the ratio understates the device side
+    out["vs_baseline_note"] = "conservative: device side includes pairgen"
     return out
 
 
@@ -741,6 +882,7 @@ _CHILDREN = {
     "pipeline_e2e": child_pipeline_e2e,
     "ladder": child_ladder,
     "hbm_scale": child_hbm_scale,
+    "scale": child_scale,
     "word2vec": child_word2vec,
     "matrix_fac": child_matrix_fac,
     "spmd_push": child_spmd_push,
@@ -924,6 +1066,7 @@ def main() -> None:
             "pipeline_e2e": results.get("pipeline_e2e", {}),
             "ladder": results.get("ladder", {}),
             "hbm_scale": results.get("hbm_scale", {}),
+            "scale": results.get("scale", {}),
             "word2vec": results.get("word2vec", {}),
             "matrix_fac": results.get("matrix_fac", {}),
             "spmd_push": results.get("spmd_push", {}),
@@ -980,6 +1123,8 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
             "hbm": _pick(
                 "hbm_scale", "num_keys_log2", "sparse_step_ex_per_sec",
                 "dense_hbm_gb_per_sec"),
+            "scale": _pick(
+                "scale", "ex_per_sec", "holdout_auc", "gb_streamed"),
             "w2v": _pick("word2vec", "pairs_per_sec_k8", "vs_baseline"),
             "mf": _pick("matrix_fac", "pairs_per_sec_k8", "vs_baseline"),
             "spmd": _pick("spmd_push", "aggregate_speedup"),

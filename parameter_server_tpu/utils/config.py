@@ -39,8 +39,11 @@ class DataConfig:
     # bucketed static shapes (TPU idiom): pad batch entry/unique arrays to
     # the next power of two above the real count instead of the
     # max_nnz_per_example worst case — host->device bytes track actual
-    # density; jit compiles once per bucket (a handful of shapes)
-    bucket_nnz: bool = False
+    # density; jit compiles once per bucket (a handful of shapes).
+    # Default ON: measured 3.5x e2e on TPU (BENCH_r03_local.json ladder,
+    # 4.1k -> 14.2k ex/s) and 5.8x on CPU (BENCH_r04 ladder) with AUC
+    # unchanged (0.854) in both — see BASELINE.md "default promotions"
+    bucket_nnz: bool = True
     # compact wire format (on by default): int32 keys + (B+1,) row_splits
     # instead of (NNZ,) row_ids on the host->device transfer — ~40% fewer
     # bytes at typical densities; the device rebuilds row ids with one
