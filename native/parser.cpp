@@ -129,6 +129,35 @@ inline bool parse_hex64(const char*& p, const char* end, uint64_t& out) {
   return true;
 }
 
+#if defined(__AVX2__)
+// 8 hex chars -> uint32 in ~12 ops (vs 8 branchy loop iterations; the
+// hex id parse is HALF of criteo parse time, measured). Validates with
+// one SSE range check; nibble = (c & 0xF) + 9*(bit6 of c), which maps
+// '0'-'9' / 'a'-'f' / 'A'-'F' without branches.
+inline bool hex8(const char* p, uint32_t& out) {
+  uint64_t w;
+  std::memcpy(&w, p, 8);
+  const __m128i v = _mm_cvtsi64_si128(static_cast<long long>(w));
+  const __m128i lower = _mm_or_si128(v, _mm_set1_epi8(0x20));
+  const __m128i dig = _mm_and_si128(
+      _mm_cmpgt_epi8(v, _mm_set1_epi8('0' - 1)),
+      _mm_cmpgt_epi8(_mm_set1_epi8('9' + 1), v));
+  const __m128i alpha = _mm_and_si128(
+      _mm_cmpgt_epi8(lower, _mm_set1_epi8('a' - 1)),
+      _mm_cmpgt_epi8(_mm_set1_epi8('f' + 1), lower));
+  if ((_mm_movemask_epi8(_mm_or_si128(dig, alpha)) & 0xFF) != 0xFF)
+    return false;
+  const uint64_t nib = (w & 0x0F0F0F0F0F0F0F0Full) +
+                       9 * ((w >> 6) & 0x0101010101010101ull);
+  const uint64_t t = ((nib << 4) | (nib >> 8)) & 0x00FF00FF00FF00FFull;
+  out = static_cast<uint32_t>(((t & 0xFF) << 24) |
+                              (((t >> 16) & 0xFF) << 16) |
+                              (((t >> 32) & 0xFF) << 8) |
+                              ((t >> 48) & 0xFF));
+  return true;
+}
+#endif
+
 inline double parse_float_slow(const char*& p, const char* end) {
   // strtod needs a NUL-terminated-ish region; lines are short, copy-free use
   // is fine because strtod stops at the first invalid char and the buffer
@@ -806,9 +835,32 @@ int ps_parse_criteo(const char* buf, int64_t len,
             ++nnz;
           }
         } else {
-          const char* fp = f;
-          uint64_t h;
-          if (parse_hex64(fp, field_end, h) && fp == field_end) {
+          uint64_t h = 0;
+          bool ok = false;
+#if defined(__AVX2__)
+          // real criteo cat ids are 8 hex chars (16 tolerated); the
+          // 8-byte loads cover exactly the field bytes, so no overread.
+          // Other lengths (and junk) take the per-char fallback
+          const int64_t flen = field_end - f;
+          if (flen == 8) {
+            uint32_t v32;
+            if (hex8(f, v32)) {
+              h = v32;
+              ok = true;
+            }
+          } else if (flen == 16) {
+            uint32_t hi32, lo32;
+            if (hex8(f, hi32) && hex8(f + 8, lo32)) {
+              h = (static_cast<uint64_t>(hi32) << 32) | lo32;
+              ok = true;
+            }
+          }
+#endif
+          if (!ok) {
+            const char* fp = f;
+            ok = parse_hex64(fp, field_end, h) && fp == field_end;
+          }
+          if (ok) {
             keys[nnz] = h;
             vals[nnz] = 1.0f;
             slots[nnz] = static_cast<uint64_t>(col - 13 + 14);

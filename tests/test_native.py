@@ -430,3 +430,43 @@ class TestAdversarialFuzzParity:
             for fl in native.iter_chunks(p, "libsvm", chunk_bytes=1 << 14)
         )
         assert total == n
+
+    def test_criteo_hex_swar_parity(self, tmp_path):
+        """SWAR 8/16-char hex ids vs the Python parser, bit-for-bit —
+        plus uppercase, junk-8 (validation must reject), short/odd
+        lengths (per-char fallback), and missing fields."""
+        import random
+
+        from parameter_server_tpu.data.libsvm import iter_criteo
+
+        rng = random.Random(11)
+        rows = []
+        for i in range(600):
+            ints = "\t".join(
+                rng.choice([str(rng.randint(0, 10**9)), "", "-3", "jk3x"])
+                for _ in range(13)
+            )
+            cats = []
+            for _ in range(26):
+                cats.append(rng.choice([
+                    "", "deadbeef", "DEADBEEF", "zzzzzzzz",
+                    f"{rng.getrandbits(32):08x}",
+                    f"{rng.getrandbits(64):016x}",
+                    f"{rng.getrandbits(16):04x}",
+                    f"{rng.getrandbits(28):07x}",
+                ]))
+            rows.append(f"{i % 2}\t{ints}\t" + "\t".join(cats) + "\n")
+        blob = "".join(rows).encode()
+        labels, splits, keys, vals, slots = native.parse_chunk(
+            "criteo", blob
+        )
+        p = tmp_path / "c.txt"
+        p.write_bytes(blob)
+        py = list(iter_criteo(p))
+        assert len(py) == len(labels) == 600
+        for i, (yl, kk, vv, ss) in enumerate(py):
+            s, e = splits[i], splits[i + 1]
+            assert labels[i] == yl
+            assert np.array_equal(keys[s:e], kk), i
+            assert np.array_equal(vals[s:e], vv), i
+            assert np.array_equal(slots[s:e], ss), i
