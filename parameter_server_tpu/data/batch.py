@@ -288,9 +288,7 @@ class BatchBuilder:
         key_dtype = (
             np.int32 if self.num_keys <= np.iinfo(np.int32).max else np.int64
         )
-        uniq = np.concatenate([[PAD_KEY], uniq]).astype(key_dtype)
-        inverse = (inverse + 1).astype(np.int32)
-        n_uniq = len(uniq)
+        n_uniq = len(uniq) + 1  # + the forced PAD row at slot 0
         if n_uniq > self.unique_capacity:
             raise ValueError(
                 f"{n_uniq} unique keys > capacity {self.unique_capacity}"
@@ -302,11 +300,16 @@ class BatchBuilder:
         else:
             nnz_cap = self.nnz_capacity
             u_cap = self.unique_capacity
+        # np.empty + explicit pad-tail zeroing, writing each entry ONCE:
+        # np.zeros-then-overwrite double-writes the big per-entry arrays
+        # (~1.5 MB/batch of pure zeroing at CTR densities), and the +1 /
+        # PAD-prepend intermediates each cost another full copy — this
+        # assembly glue, not the C localizer, bounds ingest (measured)
         out = CSRBatch(
-            unique_keys=np.zeros(u_cap, dtype=key_dtype),
-            local_ids=np.zeros(nnz_cap, dtype=np.int32),
-            row_ids=np.zeros(nnz_cap, dtype=np.int32),
-            values=np.zeros(nnz_cap, dtype=np.float32),
+            unique_keys=np.empty(u_cap, dtype=key_dtype),
+            local_ids=np.empty(nnz_cap, dtype=np.int32),
+            row_ids=np.empty(nnz_cap, dtype=np.int32),
+            values=np.empty(nnz_cap, dtype=np.float32),
             labels=np.zeros(self.batch_size, dtype=np.float32),
             example_mask=np.zeros(self.batch_size, dtype=bool),
             row_splits=np.zeros(self.batch_size + 1, dtype=np.int32),
@@ -314,10 +317,18 @@ class BatchBuilder:
             num_unique=n_uniq,
             num_entries=nnz,
         )
-        out.unique_keys[:n_uniq] = uniq
-        out.local_ids[:nnz] = inverse
+        out.unique_keys[0] = PAD_KEY
+        out.unique_keys[1:n_uniq] = uniq  # downcast copy, no intermediate
+        out.unique_keys[n_uniq:] = PAD_KEY
+        # local ids shift by one for the PAD row, written straight into
+        # the output (int64 numpy-fallback inverses narrow safely: ids
+        # are bounded by unique_capacity)
+        np.add(inverse, 1, out=out.local_ids[:nnz], casting="unsafe")
+        out.local_ids[nnz:] = 0
         out.row_ids[:nnz] = row_ids
+        out.row_ids[nnz:] = 0
         out.values[:nnz] = flat_vals
+        out.values[nnz:] = 0.0
         out.labels[:b] = np.asarray(labels, dtype=np.float32)
         out.example_mask[:b] = True
         # compact row structure: same information as row_ids in B+1 ints
