@@ -219,6 +219,20 @@ class TestChunkedStreaming:
                 chunked.extend(rows_from_flat(flat))
             assert_rows_equal(chunked, whole)
 
+    def test_single_line_longer_than_buffer_grows(self, tmp_path):
+        """One ~5 KB row streamed with 256-byte chunks: exercises the
+        reusable-buffer GROWTH path and the tail-longer-than-parsed-
+        prefix carry (the overlap-safe materialize branch)."""
+        line = "1 " + " ".join(f"{k}:1.5" for k in range(3, 603)) + "\n"
+        p = tmp_path / "long.svm"
+        p.write_text("0 7:2\n" + line + "0 9:3\n")
+        whole = rows_from_flat(native.parse_chunk("libsvm", p.read_bytes()))
+        chunked = []
+        for flat in native.iter_chunks(p, "libsvm", chunk_bytes=256):
+            chunked.extend(rows_from_flat(flat))
+        assert len(chunked) == 3
+        assert_rows_equal(chunked, whole)
+
     def test_gzip(self, tmp_path):
         import gzip
 
