@@ -56,6 +56,11 @@ Sub-benches ("sub"):
   spmd_push    — per_worker vs aggregate push wall-clock on a (data=8)
                  virtual CPU mesh (multi-device modes can't run on one
                  real chip; recorded as platform "cpu-sim").
+  wd_push      — Wide&Deep push-mode matrix (per_worker / aggregate /
+                 int8-quantized) on a (data=4, kv=2) cpu-sim mesh: the
+                 embedding push is W&D's dominant traffic, and this
+                 measures every claimed mode on the app that needs the
+                 quantized wire most.
   ingest       — host-side native parse MB/s + parse+localize ex/s per
                  stream (bounds e2e on co-located hardware).
   last_tpu_capture — present only on a CPU fallback: names the newest
@@ -95,13 +100,14 @@ CHILD_BUDGET_S = {
     "word2vec": 360,
     "matrix_fac": 300,
     "spmd_push": 300,
+    "wd_push": 420,
     "ingest": 240,
 }
 # run order = value order: the contract fields land first, platform-bound
 # numbers next, platform-independent ones last
 CHILD_ORDER = (
     "headline", "pipeline_e2e", "hbm_scale", "ladder", "scale", "word2vec",
-    "matrix_fac", "spmd_push", "ingest",
+    "matrix_fac", "spmd_push", "wd_push", "ingest",
 )
 
 
@@ -844,6 +850,59 @@ def child_spmd_push() -> dict:
     return out
 
 
+def child_wd_push() -> dict:
+    """Wide&Deep push-mode matrix on the (data=4, kv=2) virtual CPU mesh:
+    per_worker vs aggregate vs int8-quantized wall-clock on identical
+    batches (the embedding push is W&D's dominant traffic, so the mode
+    choice is this app's biggest wire knob; BASELINE.json lists W&D as a
+    parity config and the quantized mode is new this round). Multi-device
+    modes can't run on one real chip — recorded as platform cpu-sim."""
+    import jax
+
+    from parameter_server_tpu.data.batch import BatchBuilder
+    from parameter_server_tpu.data.synthetic import make_sparse_logistic
+    from parameter_server_tpu.models.wide_deep import WideDeep
+    from parameter_server_tpu.parallel.mesh import make_mesh
+    from parameter_server_tpu.utils.metrics import ProgressReporter
+
+    D, K = 4, 2
+    num_keys, bs, nnz = 1 << 18, 2048, 16
+    n = bs * D * 8  # 8 full D-shard groups per mode
+    labels, keys, vals, _ = make_sparse_logistic(
+        n, 1 << 16, nnz_per_example=nnz, noise=0.4, seed=13
+    )
+    builder = BatchBuilder(
+        num_keys=num_keys, batch_size=bs, max_nnz_per_example=4 * nnz
+    )
+    batches = [
+        builder.build(labels[i : i + bs], keys[i : i + bs], vals[i : i + bs])
+        for i in range(0, n, bs)
+    ]
+    mesh = make_mesh(D, K)
+    out: dict = {"platform": "cpu-sim", "mesh": f"data={D} kv={K}",
+                 "emb_dim": 16}
+    spc = 2  # scanned microsteps per device call
+    for mode in ("per_worker", "aggregate", "quantized"):
+        app = WideDeep(
+            num_keys=num_keys, emb_dim=16, hidden=[64, 32], mesh=mesh,
+            push_mode=mode, steps_per_call=spc, max_delay=2,
+            reporter=ProgressReporter(print_fn=lambda *_: None),
+        )
+        app.train(batches[: D * spc], report_every=10**6)  # compile warmup
+        jax.block_until_ready(app.emb_state["w"])
+        t0 = time.perf_counter()
+        app.train(batches, report_every=10**6)
+        jax.block_until_ready(app.emb_state["w"])
+        out[f"{mode}_ex_per_sec"] = round(n / (time.perf_counter() - t0), 1)
+    out["aggregate_speedup"] = round(
+        out["aggregate_ex_per_sec"] / out["per_worker_ex_per_sec"], 3
+    )
+    out["quantized_vs_per_worker"] = round(
+        out["quantized_ex_per_sec"] / out["per_worker_ex_per_sec"], 3
+    )
+    return out
+
+
 def child_ingest() -> dict:
     """Host ingest throughput (platform-independent): native parse-only
     MB/s and parse+build (localize) examples/sec per stream — the numbers
@@ -895,6 +954,7 @@ _CHILDREN = {
     "word2vec": child_word2vec,
     "matrix_fac": child_matrix_fac,
     "spmd_push": child_spmd_push,
+    "wd_push": child_wd_push,
     "ingest": child_ingest,
 }
 
@@ -1011,10 +1071,13 @@ def main() -> None:
 
     results: dict = {}
     for name in CHILD_ORDER:
-        child_env = _cpu_sim_env() if name == "spmd_push" else env
+        child_env = (
+            _cpu_sim_env() if name in ("spmd_push", "wd_push") else env
+        )
         r = _run_child(name, child_env, CHILD_BUDGET_S[name])
         results[name] = r
-        if "error" in r and name != "spmd_push" and not degraded:
+        if "error" in r and name not in ("spmd_push", "wd_push") \
+                and not degraded:
             # the accelerator may have wedged mid-suite: re-probe, and run
             # everything that's left on the CPU fallback if it's gone
             if _probe_backend(env, timeout_s=90.0) is None:
@@ -1079,6 +1142,7 @@ def main() -> None:
             "word2vec": results.get("word2vec", {}),
             "matrix_fac": results.get("matrix_fac", {}),
             "spmd_push": results.get("spmd_push", {}),
+            "wd_push": results.get("wd_push", {}),
             "ingest": results.get("ingest", {}),
         },
         "suite_wall_s": round(time.perf_counter() - t_start, 1),
@@ -1137,6 +1201,9 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
             "w2v": _pick("word2vec", "pairs_per_sec_k8", "vs_baseline"),
             "mf": _pick("matrix_fac", "pairs_per_sec_k8", "vs_baseline"),
             "spmd": _pick("spmd_push", "aggregate_speedup"),
+            "wd": _pick(
+                "wd_push", "per_worker_ex_per_sec",
+                "quantized_vs_per_worker"),
             "ingest": _pick(
                 "ingest", "parse_mb_per_sec", "parse_build_ex_per_sec"),
         },
