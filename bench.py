@@ -284,10 +284,19 @@ def bench_pallas_ftrl() -> dict:
             out[f"fused_push_p{log2}"] = _bench_fused_push(log2)
         except Exception as e:  # noqa: BLE001 — keep the capture alive
             out[f"fused_push_p{log2}"] = {"error": repr(e)[-300:]}
+    # embedding-shaped AdaGrad (vdim 64, MF/W&D territory): each row DMA
+    # moves a real vector — the most plausible fused-push win
+    try:
+        out["fused_push_adagrad_v64"] = _bench_fused_push(
+            20, updater="adagrad", vdim=64, u_pow=15
+        )
+    except Exception as e:  # noqa: BLE001
+        out["fused_push_adagrad_v64"] = {"error": repr(e)[-300:]}
     return out
 
 
-def _bench_fused_push(rows_log2: int) -> dict:
+def _bench_fused_push(rows_log2: int, updater: str = "ftrl",
+                      vdim: int = 1, u_pow: int = 17) -> dict:
     """Touched-rows/sec of kv.store.push (gather + fused elementwise +
     scatter-add) vs the fused Pallas kernel, both with donated state
     (in-place tables, the steady-state training shape)."""
@@ -295,44 +304,56 @@ def _bench_fused_push(rows_log2: int) -> dict:
     import jax.numpy as jnp
 
     from parameter_server_tpu.kv import store
-    from parameter_server_tpu.kv.updaters import Ftrl
-    from parameter_server_tpu.ops.pallas_kernels import ftrl_push_pallas
+    from parameter_server_tpu.kv.updaters import Adagrad, Ftrl
+    from parameter_server_tpu.ops.pallas_kernels import (
+        adagrad_push_pallas,
+        ftrl_push_pallas,
+    )
 
     K = 1 << rows_log2
     rng = np.random.default_rng(9)
-    idx = jnp.asarray(np.unique(rng.integers(1, K, 1 << 17)).astype(np.int32))
+    idx = jnp.asarray(
+        np.unique(rng.integers(1, K, 1 << u_pow)).astype(np.int32)
+    )
     u = int(idx.shape[0])
-    g = jnp.asarray(rng.normal(size=(u, 1)).astype(np.float32))
-    up = Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2)
+    g = jnp.asarray(rng.normal(size=(u, vdim)).astype(np.float32))
+    if updater == "ftrl":
+        up = Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2)
+        keys_ab = ("z", "n")
+        fused = lambda st, i_, g_: ftrl_push_pallas(  # noqa: E731
+            st, i_, g_, alpha=ALPHA, beta=BETA, l1=L1, l2=L2
+        )
+    else:
+        up = Adagrad(eta=0.1)
+        keys_ab = ("w", "n")
+        fused = lambda st, i_, g_: adagrad_push_pallas(  # noqa: E731
+            st, i_, g_, eta=0.1
+        )
     composite = jax.jit(
         lambda st, i_, g_: store.push(up, st, i_, g_), donate_argnums=0
     )
-    fused = lambda st, i_, g_: ftrl_push_pallas(  # noqa: E731
-        st, i_, g_, alpha=ALPHA, beta=BETA, l1=L1, l2=L2
-    )
 
     def _rows_per_sec(f) -> float:
-        st = {
-            "z": jnp.zeros((K, 1), jnp.float32),
-            "n": jnp.zeros((K, 1), jnp.float32),
-        }
+        st = {k: jnp.zeros((K, vdim), jnp.float32) for k in keys_ab}
         st = f(st, idx, g)
-        jax.block_until_ready(st["z"])  # compile
+        jax.block_until_ready(st[keys_ab[0]])  # compile
         t0 = time.perf_counter()
         st = f(st, idx, g)
-        jax.block_until_ready(st["z"])
+        jax.block_until_ready(st[keys_ab[0]])
         probe = max(time.perf_counter() - t0, 1e-5)
         iters = min(max(5, int(0.5 / probe)), 200)  # capped (tunnel stalls)
         t0 = time.perf_counter()
         for _ in range(iters):
             st = f(st, idx, g)
-        jax.block_until_ready(st["z"])
+        jax.block_until_ready(st[keys_ab[0]])
         return u * iters / (time.perf_counter() - t0)
 
     comp = _rows_per_sec(composite)
     fus = _rows_per_sec(fused)
     return {
         "rows_log2": rows_log2,
+        "updater": updater,
+        "vdim": vdim,
         "touched_rows": u,
         "composite_rows_per_sec": round(comp, 1),
         "fused_rows_per_sec": round(fus, 1),

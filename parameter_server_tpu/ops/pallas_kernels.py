@@ -193,57 +193,131 @@ def quantize_stochastic_pallas(
 _PUSH_TILE = 256  # touched rows per grid step (DMAs in flight per wave)
 
 
-def _ftrl_push_kernel(idx_ref, g_ref, z_in, n_in, z_out, n_out,
-                      zbuf, nbuf, sem, *, alpha, beta, l1, l2, tile):
-    from jax import lax
-    from jax.experimental.pallas import tpu as pltpu
+def _make_push2_kernel(update_rows, tile):
+    """Scaffold for fused pushes over TWO-table row state ((z,n) FTRL,
+    (w,n) AdaGrad): per-tile row DMAs in, ``update_rows(a, b, g) ->
+    (a_new, b_new)`` in-register, row DMAs back. The updater math is the
+    only part that varies; the DMA choreography is shared."""
 
-    del z_in, n_in  # aliased: z_out/n_out ARE the live tables
+    def kernel(idx_ref, g_ref, a_in, b_in, a_out, b_out, abuf, bbuf, sem):
+        from jax import lax
+        from jax.experimental.pallas import tpu as pltpu
 
-    def gather(i, _):
-        r = idx_ref[i]
-        pltpu.make_async_copy(z_out.at[r], zbuf.at[i], sem).start()
-        pltpu.make_async_copy(n_out.at[r], nbuf.at[i], sem).start()
-        return 0
+        del a_in, b_in  # aliased: a_out/b_out ARE the live tables
 
-    lax.fori_loop(0, tile, gather, 0)
+        def gather(i, _):
+            r = idx_ref[i]
+            pltpu.make_async_copy(a_out.at[r], abuf.at[i], sem).start()
+            pltpu.make_async_copy(b_out.at[r], bbuf.at[i], sem).start()
+            return 0
 
-    def gather_wait(i, _):
-        r = idx_ref[i]
-        pltpu.make_async_copy(z_out.at[r], zbuf.at[i], sem).wait()
-        pltpu.make_async_copy(n_out.at[r], nbuf.at[i], sem).wait()
-        return 0
+        lax.fori_loop(0, tile, gather, 0)
 
-    lax.fori_loop(0, tile, gather_wait, 0)
+        def gather_wait(i, _):
+            r = idx_ref[i]
+            pltpu.make_async_copy(a_out.at[r], abuf.at[i], sem).wait()
+            pltpu.make_async_copy(b_out.at[r], bbuf.at[i], sem).wait()
+            return 0
 
-    z = zbuf[:]
-    n = nbuf[:]
-    g = g_ref[:]
+        lax.fori_loop(0, tile, gather_wait, 0)
+
+        a_new, b_new = update_rows(abuf[:], bbuf[:], g_ref[:])
+        abuf[:] = a_new
+        bbuf[:] = b_new
+
+        def scatter(i, _):
+            r = idx_ref[i]
+            pltpu.make_async_copy(abuf.at[i], a_out.at[r], sem).start()
+            pltpu.make_async_copy(bbuf.at[i], b_out.at[r], sem).start()
+            return 0
+
+        lax.fori_loop(0, tile, scatter, 0)
+
+        def scatter_wait(i, _):
+            r = idx_ref[i]
+            pltpu.make_async_copy(abuf.at[i], a_out.at[r], sem).wait()
+            pltpu.make_async_copy(bbuf.at[i], b_out.at[r], sem).wait()
+            return 0
+
+        lax.fori_loop(0, tile, scatter_wait, 0)
+
+    return kernel
+
+
+def _ftrl_update_rows(alpha, beta, l1, l2):
     # identical op ORDER to Ftrl.delta + the scatter-add (z + (dz)); the
     # composite may still differ by ULPs where XLA contracts a
     # multiply-add pair into one FMA (e.g. n + g*g)
-    shrunk = jnp.sign(z) * jnp.maximum(jnp.abs(z) - l1, 0.0)
-    w = -shrunk / ((beta + jnp.sqrt(n)) / alpha + l2)
-    g2 = g * g
-    sigma = (jnp.sqrt(n + g2) - jnp.sqrt(n)) / alpha
-    zbuf[:] = z + (g - sigma * w)
-    nbuf[:] = n + g2
+    def update(z, n, g):
+        shrunk = jnp.sign(z) * jnp.maximum(jnp.abs(z) - l1, 0.0)
+        w = -shrunk / ((beta + jnp.sqrt(n)) / alpha + l2)
+        g2 = g * g
+        sigma = (jnp.sqrt(n + g2) - jnp.sqrt(n)) / alpha
+        return z + (g - sigma * w), n + g2
 
-    def scatter(i, _):
-        r = idx_ref[i]
-        pltpu.make_async_copy(zbuf.at[i], z_out.at[r], sem).start()
-        pltpu.make_async_copy(nbuf.at[i], n_out.at[r], sem).start()
-        return 0
+    return update
 
-    lax.fori_loop(0, tile, scatter, 0)
 
-    def scatter_wait(i, _):
-        r = idx_ref[i]
-        pltpu.make_async_copy(zbuf.at[i], z_out.at[r], sem).wait()
-        pltpu.make_async_copy(nbuf.at[i], n_out.at[r], sem).wait()
-        return 0
+def _adagrad_update_rows(eta, eps, l2):
+    # mirrors Adagrad.delta + scatter-add: g' = g + l2*w; dn = g'^2;
+    # w += -eta*g'/(sqrt(n+dn)+eps); n += dn
+    def update(w, n, g):
+        g = g + l2 * w
+        dn = g * g
+        n_new = n + dn
+        return w + (-eta * g / (jnp.sqrt(n_new) + eps)), n_new
 
-    lax.fori_loop(0, tile, scatter_wait, 0)
+    return update
+
+
+def _push2_pallas(a, b, idx, grad, update_rows):
+    """Shared pallas_call plumbing for the fused two-table pushes: pads
+    the touched set to a tile multiple (pad slots hit key 0 with zero
+    grad), DMAs rows through VMEM, and aliases both tables in place.
+
+    Pad-slot semantics: the kernel row-OVERWRITES where the composite
+    scatter-ADDs, so duplicate pad slots agree with kv.store.push only
+    when the pad row's update is exactly zero. That holds for FTRL with
+    ANY row-0 state (zero grad -> zero delta); for AdaGrad with l2 > 0
+    it additionally relies on the framework invariant that the PAD row's
+    state IS zero (init zeros it, dumps/updates exclude it, and a zero
+    w[0] keeps l2*w[0] zero forever). Callers that break that invariant
+    get divergent row-0 garbage in both paths — don't."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    vdim = a.shape[1]
+    u = idx.shape[0]
+    tile = min(_PUSH_TILE, max(8, u))
+    u_pad = (u + tile - 1) // tile * tile
+    if u_pad != u:
+        idx = jnp.pad(idx, (0, u_pad - u))
+        grad = jnp.pad(grad, ((0, u_pad - u), (0, 0)))
+    return pl.pallas_call(
+        _make_push2_kernel(update_rows, tile),
+        grid=(u_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile, vdim), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(a.shape, a.dtype),
+            jax.ShapeDtypeStruct(b.shape, b.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tile, vdim), jnp.float32),
+            pltpu.VMEM((tile, vdim), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        input_output_aliases={2: 0, 3: 1},
+    )(idx.astype(jnp.int32), grad, a, b)
 
 
 @functools.partial(
@@ -263,46 +337,32 @@ def ftrl_push_pallas(
     per row instead of the composite's two. Same contract as
     kv.store.push (unique real keys; duplicate PAD rows carry zero grad,
     so their concurrent same-value row writes are benign)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    z, n = state["z"], state["n"]
-    vdim = z.shape[1]
-    u = idx.shape[0]
-    tile = min(_PUSH_TILE, max(8, u))
-    u_pad = (u + tile - 1) // tile * tile
-    if u_pad != u:  # pad rows hit key 0 with zero grad (inert by contract)
-        idx = jnp.pad(idx, (0, u_pad - u))
-        grad = jnp.pad(grad, ((0, u_pad - u), (0, 0)))
-    kernel = functools.partial(
-        _ftrl_push_kernel, alpha=alpha, beta=beta, l1=l1, l2=l2, tile=tile
+    z2, n2 = _push2_pallas(
+        state["z"], state["n"], idx, grad,
+        _ftrl_update_rows(alpha, beta, l1, l2),
     )
-    z2, n2 = pl.pallas_call(
-        kernel,
-        grid=(u_pad // tile,),
-        in_specs=[
-            pl.BlockSpec((tile,), lambda i: (i,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((tile, vdim), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=(
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct(z.shape, z.dtype),
-            jax.ShapeDtypeStruct(n.shape, n.dtype),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((tile, vdim), jnp.float32),
-            pltpu.VMEM((tile, vdim), jnp.float32),
-            pltpu.SemaphoreType.DMA,
-        ],
-        input_output_aliases={2: 0, 3: 1},
-    )(idx.astype(jnp.int32), grad, z, n)
     return {"z": z2, "n": n2}
+
+
+@functools.partial(
+    jax.jit, static_argnames=("eta", "eps", "l2"), donate_argnums=(0,)
+)
+def adagrad_push_pallas(
+    state: dict,
+    idx: jax.Array,
+    grad: jax.Array,
+    *,
+    eta: float,
+    eps: float = 1e-8,
+    l2: float = 0.0,
+) -> dict:
+    """Fused in-place AdaGrad push — the embedding-table updater (W&D
+    emb, MF factors, word2vec tables), where vdim is 16-64 and each row
+    DMA moves a real vector; the most plausible fused-push win."""
+    w2, n2 = _push2_pallas(
+        state["w"], state["n"], idx, grad, _adagrad_update_rows(eta, eps, l2)
+    )
+    return {"w": w2, "n": n2}
 
 
 def tpu_available() -> bool:

@@ -111,3 +111,50 @@ class TestFusedPushKernel:
         np.testing.assert_array_equal(
             np.asarray(got["z"])[untouched], z[untouched]
         )
+
+    @pytest.mark.parametrize(
+        "vdim,u,l2",
+        [
+            # (16, 300) forces kernel-internal tile padding (u_pad > u);
+            # every case carries DUPLICATE pad slots. With l2 > 0 the
+            # pad row's inertness relies on the framework invariant that
+            # row 0's state is zero (init + dump exclusion maintain it);
+            # the l2=0 case keeps a random nonzero row 0 to show zero
+            # grad is inert for ANY state there.
+            (16, 300, 0.01),
+            (64, 40, 0.01),
+            (16, 120, 0.0),
+        ],
+    )
+    def test_adagrad_matches_store_push(self, interpret_mode, rng, vdim, u, l2):
+        """Same scaffold, AdaGrad math (the embedding-table updater):
+        parity against kv.store.push at embedding-shaped vdims,
+        including duplicate pad slots and tile-padded shapes."""
+        from parameter_server_tpu.kv import store
+        from parameter_server_tpu.kv.updaters import Adagrad
+        from parameter_server_tpu.ops.pallas_kernels import adagrad_push_pallas
+
+        K = 1024
+        w = rng.normal(size=(K, vdim)).astype(np.float32)
+        n = np.abs(rng.normal(size=(K, vdim))).astype(np.float32)
+        if l2 > 0.0:
+            w[0] = 0.0  # the PAD-row invariant the framework maintains
+            n[0] = 0.0
+        uniq = np.unique(rng.integers(1, K, u))
+        idx = np.concatenate([uniq, [0, 0]])
+        g = rng.normal(size=(len(idx), vdim)).astype(np.float32)
+        g[len(uniq):] = 0.0
+        up = Adagrad(eta=0.1, eps=1e-8, lambda_l2=l2)
+        ref = store.push(
+            up, {"w": jnp.asarray(w), "n": jnp.asarray(n)},
+            jnp.asarray(idx), jnp.asarray(g),
+        )
+        got = adagrad_push_pallas(
+            {"w": jnp.asarray(w), "n": jnp.asarray(n)},
+            jnp.asarray(idx), jnp.asarray(g),
+            eta=0.1, eps=1e-8, l2=l2,
+        )
+        for k in ("w", "n"):
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(ref[k]), rtol=1e-6, atol=1e-6
+            )
