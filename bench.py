@@ -1198,6 +1198,17 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
             return {"error": str(d["error"])[-80:]}
         return {k: d[k] for k in keys if k in d}
 
+    # fused-push speedups (VERDICT r4 #3's headline question) must reach
+    # the driver-recorded line, not just the full file
+    fused = {}
+    pall = full["sub"].get("pallas_ftrl") or {}
+    for key, short in (("fused_push_p20", "p20"), ("fused_push_p27", "p27"),
+                       ("fused_push_adagrad_v64", "ada64")):
+        d = pall.get(key) or {}
+        if "fused_speedup" in d:
+            fused[short] = d["fused_speedup"]
+        elif "error" in d:
+            fused[short] = "error"
     compact = {
         "metric": full["metric"],
         "value": full["value"],
@@ -1210,6 +1221,7 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
             "pallas_ftrl": _pick(
                 "pallas_ftrl", "pallas_speedup",
                 "interpret_matches_jnp", "mode"),
+            **({"fused_push": fused} if fused else {}),
             "e2e": _pick(
                 "pipeline_e2e", "pipelined_k8_ex_per_sec", "auc_k8",
                 "fastest"),
