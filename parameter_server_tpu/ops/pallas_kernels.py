@@ -179,15 +179,24 @@ def quantize_stochastic_pallas(
 
 
 # ---------------------------------------------------------------------------
-# fused gather -> FTRL -> scatter (the reference's HOT LOOP #2 as ONE
+# fused gather -> update -> scatter (the reference's HOT LOOP #2 as ONE
 # kernel; SURVEY §2.3 KVMap TPU plan). The XLA composite (kv/store.push)
 # is gather + fused-elementwise + scatter-add: the touched rows make two
 # HBM round trips (gather read; scatter read-modify-write). This kernel
 # makes one — per-tile row DMAs into VMEM, the delta in-register, row
 # DMAs back — with the tables aliased in place. Whether the DMA-per-row
-# cost beats XLA's native gather/scatter at vdim=1 is exactly what
-# bench.py's ftrl_fused comparison exists to measure (VERDICT r4 #3:
-# build it and let the winner-picks guard decide).
+# cost beats XLA's native gather/scatter is exactly what bench.py's
+# fused_push_* comparisons exist to measure (VERDICT r4 #3: build it and
+# let the winner-picks guard decide).
+#
+# Scope note: the INTEGRATED train step (models/linear.train_step) shares
+# its pull gather with the update — its rows are already in registers
+# when the delta runs, so its scatter-add costs one read+write per row,
+# the same traffic as this kernel. The fused push therefore targets the
+# STANDALONE push path — the wire-tier server applying pushes without a
+# forward (parallel/multislice), and kv.store API users — not the fused
+# single-step trainer, whose headline number it cannot improve
+# mechanically.
 # ---------------------------------------------------------------------------
 
 _PUSH_TILE = 256  # touched rows per grid step (DMAs in flight per wave)
