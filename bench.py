@@ -53,6 +53,8 @@ Sub-benches ("sub"):
                  on identical batch semantics (vs_baseline).
   matrix_fac   — MF rating-triple throughput (BASELINE's MovieLens-shaped
                  config) with a single-core numpy baseline (vs_baseline).
+  darlin       — DARLIN batch-solver block passes/sec + objective/nnz
+                 (the reference's second flagship; RCV1-shaped L1-LR).
   spmd_push    — per_worker vs aggregate push wall-clock on a (data=8)
                  virtual CPU mesh (multi-device modes can't run on one
                  real chip; recorded as platform "cpu-sim").
@@ -101,13 +103,14 @@ CHILD_BUDGET_S = {
     "matrix_fac": 300,
     "spmd_push": 300,
     "wd_push": 420,
+    "darlin": 300,
     "ingest": 240,
 }
 # run order = value order: the contract fields land first, platform-bound
 # numbers next, platform-independent ones last
 CHILD_ORDER = (
     "headline", "pipeline_e2e", "hbm_scale", "ladder", "scale", "word2vec",
-    "matrix_fac", "spmd_push", "wd_push", "ingest",
+    "matrix_fac", "darlin", "spmd_push", "wd_push", "ingest",
 )
 
 
@@ -871,6 +874,46 @@ def child_spmd_push() -> dict:
     return out
 
 
+def child_darlin() -> dict:
+    """DARLIN batch-solver throughput (the reference's second flagship;
+    BASELINE's RCV1-shaped L1-LR parity config): block passes/sec of the
+    resident single-device solve on the e2e synthetic family, plus the
+    objective it reaches and the sparsity the KKT filter keeps."""
+    from parameter_server_tpu.data.blockcache import ColumnBlocks
+    from parameter_server_tpu.models.darlin import Darlin
+    from parameter_server_tpu.utils.config import PSConfig
+    from parameter_server_tpu.utils.metrics import ProgressReporter
+
+    n, blocks = 1 << 16, 32
+    batches = _make_batches(n_batches=n // BATCH, num_keys=1 << 18,
+                            feature_space=1 << 16, seed=29)
+    cfg = PSConfig()
+    cfg.data.num_keys = 1 << 18
+    cfg.solver.algo = "darlin"
+    cfg.solver.feature_blocks = blocks
+    cfg.solver.block_iters = 4
+    cfg.solver.kkt_filter_threshold = 0.1  # exercise the KKT active set
+    cfg.penalty.lambda_l1 = 1.0
+    out: dict = {"platform": _platform(), "examples": n, "blocks": blocks}
+    quiet = ProgressReporter(print_fn=lambda *_: None)
+    # pack the column blocks ONCE outside the timed region (fit() would
+    # rebuild them per call — host packing is not solver throughput)
+    cb = ColumnBlocks.from_batches(batches, cfg.data.num_keys, blocks)
+    Darlin(cfg, reporter=quiet).fit_blocks(cb)  # compile warmup
+    t0 = time.perf_counter()
+    res = Darlin(cfg, reporter=quiet).fit_blocks(cb)
+    dt = time.perf_counter() - t0
+    # the solver may early-stop on its relative-objective epsilon: rate
+    # uses the pass count it actually ran, not the configured ceiling
+    iters_ran = max(int(res.get("iters", cfg.solver.block_iters)), 1)
+    out["block_passes"] = iters_ran
+    out["block_passes_per_sec"] = round(blocks * iters_ran / dt, 2)
+    out["example_blocks_per_sec"] = round(n * blocks * iters_ran / dt, 1)
+    out["objv"] = round(res["objv"], 4)
+    out["nnz_w"] = res.get("nnz_w")
+    return out
+
+
 def child_wd_push() -> dict:
     """Wide&Deep push-mode matrix on the (data=4, kv=2) virtual CPU mesh:
     per_worker vs aggregate vs int8-quantized wall-clock on identical
@@ -1004,6 +1047,7 @@ _CHILDREN = {
     "scale": child_scale,
     "word2vec": child_word2vec,
     "matrix_fac": child_matrix_fac,
+    "darlin": child_darlin,
     "spmd_push": child_spmd_push,
     "wd_push": child_wd_push,
     "ingest": child_ingest,
@@ -1192,6 +1236,7 @@ def main() -> None:
             "scale": results.get("scale", {}),
             "word2vec": results.get("word2vec", {}),
             "matrix_fac": results.get("matrix_fac", {}),
+            "darlin": results.get("darlin", {}),
             "spmd_push": results.get("spmd_push", {}),
             "wd_push": results.get("wd_push", {}),
             "ingest": results.get("ingest", {}),
@@ -1263,6 +1308,7 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
                 "scale", "ex_per_sec", "holdout_auc", "gb_streamed"),
             "w2v": _pick("word2vec", "pairs_per_sec_k8", "vs_baseline"),
             "mf": _pick("matrix_fac", "pairs_per_sec_k8", "vs_baseline"),
+            "darlin": _pick("darlin", "block_passes_per_sec", "objv"),
             "spmd": _pick("spmd_push", "aggregate_speedup"),
             "wd": _pick(
                 "wd_push", "per_worker_ex_per_sec",
