@@ -1146,11 +1146,23 @@ def _newest_tpu_capture() -> str | None:
         for p in glob.glob(os.path.join(here, "BENCH_r*_local.json"))
         if (m := re.search(r"r(\d+)", os.path.basename(p)))
     ]
-    if not caps:
+    # only REAL-hardware captures qualify: committed CPU-fallback
+    # captures (e.g. BENCH_r05_cpu_local.json) record their platform
+    # inside — filter on it, not just the filename
+    tpu_caps = []
+    for m, p in caps:
+        try:
+            with open(p) as f:
+                d = json.load(f)
+            if isinstance(d, dict) and "tpu" in str(d.get("platform", "")):
+                tpu_caps.append((m, p))
+        except Exception:  # noqa: BLE001 — a bad capture file must never
+            continue  # kill the suite before the contract line prints
+    if not tpu_caps:
         return None
     # numeric round sort: lexicographic would rank r9 above r10
-    caps.sort(key=lambda mp: int(mp[0].group(1)))
-    return os.path.basename(caps[-1][1])
+    tpu_caps.sort(key=lambda mp: int(mp[0].group(1)))
+    return os.path.basename(tpu_caps[-1][1])
 
 
 def main() -> None:
