@@ -484,3 +484,49 @@ class TestAdversarialFuzzParity:
             assert np.array_equal(keys[s:e], kk), i
             assert np.array_equal(vals[s:e], vv), i
             assert np.array_equal(slots[s:e], ss), i
+
+
+@pytest.mark.skipif(
+    not native.native_available(), reason="native parser failed to build"
+)
+class TestCount4:
+    """ps_count4 underpins the wrapper's exact output sizing: wrong
+    counts would silently become capacity errors or overallocation."""
+
+    def _lib(self):
+        lib = native.load_native()
+        if not hasattr(lib, "ps_count4"):
+            # older prebuilt artifact (the wrapper tolerates its absence)
+            pytest.skip("native lib lacks ps_count4")
+        return lib
+
+    def test_counts_match_python(self):
+        import ctypes
+        import random
+
+        rng = random.Random(3)
+        blob = bytes(
+            rng.choice(b"abc:\n\r \t059")
+            for _ in range(100_000)
+        )
+        lib = self._lib()
+        ba = bytearray(blob)
+        out = (ctypes.c_int64 * 4)()
+        lib.ps_count4(
+            (ctypes.c_char * len(ba)).from_buffer(ba), len(ba),
+            0x0A, 0x0D, ord(":"), ord(" "), out,
+        )
+        expect = [blob.count(bytes([c])) for c in (0x0A, 0x0D, ord(":"), ord(" "))]
+        assert list(out) == expect
+
+    def test_partial_length_and_tail(self):
+        import ctypes
+
+        lib = self._lib()
+        ba = bytearray(b":" * 37 + b"\n" * 5)  # 42 bytes: SIMD blocks + tail
+        out = (ctypes.c_int64 * 4)()
+        lib.ps_count4(
+            (ctypes.c_char * len(ba)).from_buffer(ba), 40,  # counts only [:40]
+            ord(":"), 0x0A, 0x00, 0x00, out,
+        )
+        assert out[0] == 37 and out[1] == 3

@@ -275,3 +275,27 @@ class TestWord2VecSPMD:
         within = np.mean([w2v.similarity(0, i) for i in range(1, 5)])
         across = np.mean([w2v.similarity(0, i) for i in range(5, 10)])
         assert within > across + 0.3, (within, across)
+
+
+class TestWideDeepQuantizedFromConfig:
+    def test_factory_accepts_quantized_and_trains(self, tmp_path):
+        """The config path (TOML [parallel] push_mode = quantized ->
+        WideDeep.from_config) must construct AND train — the factory
+        used to raise on this schema-valid value."""
+        from parameter_server_tpu.utils.config import load_config
+
+        cfg_p = tmp_path / "wd.toml"
+        cfg_p.write_text(
+            '[data]\nnum_keys = 64\n'
+            '[wd]\nemb_dim = 8\nhidden = [16]\n'
+            '[solver]\nsteps_per_call = 2\n'
+            '[parallel]\npush_mode = "quantized"\n'
+        )
+        cfg = load_config(cfg_p)
+        mesh = make_mesh(2, 2)
+        app = WideDeep.from_config(cfg, mesh=mesh, reporter=quiet())
+        builder = BatchBuilder(num_keys=64, batch_size=256, key_mode="identity")
+        batches, _ = TestWideDeepSPMD()._xor_batches(builder, n=1024)
+        app.train(batches, report_every=10**6)
+        assert app.push_mode == "quantized"
+        assert app._push_calls == len(batches) // (2 * 2)
