@@ -85,6 +85,13 @@ def _build_parser() -> argparse.ArgumentParser:
         help="server recovery dir: resume this range's dump if present; "
         "periodic dumps per [fault] server_ckpt_interval_s",
     )
+    nd.add_argument(
+        "--fault_plan", default="",
+        help="chaos spec (parallel/chaos.py DSL) armed on this node's "
+        "RpcServers; overrides PS_FAULT_PLAN and the config's [fault] "
+        "fault_plan",
+    )
+    nd.add_argument("--fault_seed", type=int, default=0)
 
     cv = sub.add_parser(
         "convert",
@@ -106,6 +113,13 @@ def _build_parser() -> argparse.ArgumentParser:
     la.add_argument("--num_servers", type=int, default=1)
     la.add_argument("--num_workers", type=int, default=1)
     la.add_argument("--model_out", default="")
+    la.add_argument(
+        "--fault_plan", default="",
+        help="chaos spec (parallel/chaos.py DSL) armed on EVERY spawned "
+        "node via PS_FAULT_PLAN — seeded drop/delay/disconnect/duplicate "
+        "frame faults for recovery drills",
+    )
+    la.add_argument("--fault_seed", type=int, default=0)
     return p
 
 
@@ -489,6 +503,11 @@ def main(argv: list[str] | None = None) -> int:
     elif args.cmd == "node":
         from parameter_server_tpu.parallel.multislice import run_node
 
+        if args.fault_plan:
+            # flag wins over both the ambient env and the config file; the
+            # cfg field carries it into every RpcServer this node builds
+            cfg.fault.fault_plan = args.fault_plan
+            cfg.fault.fault_seed = args.fault_seed
         out = run_node(
             cfg, args.role, args.rank, args.scheduler,
             args.num_servers, args.num_workers, args.model_out,
@@ -501,7 +520,8 @@ def main(argv: list[str] | None = None) -> int:
         from parameter_server_tpu.parallel.multislice import launch_local
 
         out = launch_local(
-            args.app_file, args.num_servers, args.num_workers, args.model_out
+            args.app_file, args.num_servers, args.num_workers, args.model_out,
+            fault_plan=args.fault_plan, fault_seed=args.fault_seed,
         )
     print(json.dumps(out, default=float))
     return 0

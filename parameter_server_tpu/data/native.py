@@ -192,8 +192,9 @@ _PAD = 8
 
 
 # fourth needle per format for ps_count4 (first three are \n, \r, and the
-# format's entry marker); counts[3] is only used by adfea
-_COUNT_NEEDLES = {"libsvm": b":\0", "criteo": b"\t\0", "adfea": b" \t"}
+# format's entry marker); counts[3] refines the entry bound for libsvm
+# (space-preceded bare ``k`` entries) and adfea (ws-preceded entries)
+_COUNT_NEEDLES = {"libsvm": b": ", "criteo": b"\t\0", "adfea": b" \t"}
 
 
 def _counts(lib, fmt: str, ba: bytearray, length: int) -> tuple[int, int]:
@@ -218,7 +219,12 @@ def _counts(lib, fmt: str, ba: bytearray, length: int) -> tuple[int, int]:
         out = [ba.count(bytes([c]), 0, length) for c in (0x0A, 0x0D, c3, c4)]
     rows_cap = out[0] + out[1] + 1
     if fmt == "libsvm":
-        nnz_cap = out[2] + 1
+        # colons are exact for ``k:v`` entries; bare ``k`` entries carry no
+        # colon but are each preceded by >= 1 space, so the space count is
+        # the complementary bound — max of the two avoids the grow-retry
+        # cliff on colon-free chunks (tab-separated bare keys still
+        # undershoot and take the retry, whose jump below is linear)
+        nnz_cap = max(out[2], out[3]) + 1
     elif fmt == "criteo":
         nnz_cap = 39 * rows_cap + 1  # hard bound: <= 39 features per row
     else:  # adfea: every entry is preceded by at least one ws byte
@@ -269,10 +275,13 @@ def _parse_region(fmt: str, ba: bytearray, length: int) -> FlatRows:
         )
         if rc == -1:
             # nnz bound undershoot (bare-key libsvm): rows_cap is exact
-            # (newline count), so only the entry bound can overflow. The
+            # (newline count), so only the entry bound can overflow. Jump
+            # straight to a bytes-per-entry estimate (entries are >= ~6
+            # bytes in practice) so a badly-undershot seed converges in
+            # one or two retries instead of O(log n) full re-parses. The
             # hard floor is 2 bytes/entry; hitting it twice means the C
             # side's capacity accounting is broken — raise, don't spin
-            new_cap = min(2 * nnz_cap + 64, length // 2 + 1)
+            new_cap = min(max(2 * nnz_cap + 64, length // 6), length // 2 + 1)
             if new_cap == nnz_cap:
                 raise RuntimeError(
                     "native parser capacity overflow (internal bug)"
@@ -330,12 +339,15 @@ def iter_chunks(
         mv = memoryview(ba)
         tail = 0
         while True:
-            if tail + _PAD >= cap:  # single line longer than the buffer
+            if tail + _PAD + 1 >= cap:  # single line longer than the buffer
                 cap *= 2
                 nba = bytearray(cap)
                 nba[:tail] = mv[:tail]
                 ba, mv = nba, memoryview(nba)
-            n = f.readinto(mv[tail : cap - _PAD])
+            # reserve _PAD + 1 bytes past the read: the EOF branch may
+            # append a closing 0x0A, and the appended terminator must
+            # still leave the full _PAD slack _parse_region documents
+            n = f.readinto(mv[tail : cap - _PAD - 1])
             total = tail + (n or 0)
             if not n:
                 if total and bytes(mv[:total]).strip():

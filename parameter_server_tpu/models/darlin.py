@@ -345,7 +345,7 @@ def make_darlin_spmd_fns(
     from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from jax import shard_map
+    from parameter_server_tpu.utils.jaxcompat import shard_map
 
     kv = mesh.shape["kv"]
     if num_keys % kv:

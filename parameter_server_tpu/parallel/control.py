@@ -23,24 +23,38 @@ describes the (name, dtype, shape) of each contiguous numpy payload. With
 ``zip`` set the payload block is zlib-compressed (ref: the compressing
 filter, src/filter/compressing.h — byte compression earns its place back on
 a real wire).
+
+Delivery semantics (ref: the paper's vector-clock idempotent
+retransmission, rebuilt for this wire format): every ``RpcClient`` request
+carries a client id + sequence number; on a mid-call socket error or
+truncated frame the client transparently reconnects (exponential backoff +
+jitter) and *resends the same sequence number*. The server keeps a small
+per-client reply cache, so a resent or duplicated non-idempotent command
+(``workload_fetch``, ``ssp_finish``, ``barrier`` arrivals, pushes) is
+answered from the cache instead of double-applied — at-least-once delivery
+on the wire, exactly-once application at the handler.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
 import threading
 import time
+import uuid
 import zlib
+from collections import OrderedDict
 from typing import Any, Callable
 
 import numpy as np
 
+from parameter_server_tpu.parallel.chaos import FaultPlan
 from parameter_server_tpu.parallel.ssp import SSPClock
 from parameter_server_tpu.parallel.workload import WorkloadPool
 from parameter_server_tpu.utils.heartbeat import HeartbeatMonitor
-from parameter_server_tpu.utils.metrics import merge_progress
+from parameter_server_tpu.utils.metrics import merge_progress, wire_counters
 
 _LEN = struct.Struct("<II")
 
@@ -110,10 +124,35 @@ def recv_frame(sock: socket.socket) -> tuple[dict[str, Any], Arrays]:
     return header, arrays
 
 
+class _DedupEntry:
+    """One cached reply. ``event`` lets a resent/duplicated frame that
+    arrives while the first delivery is still being applied (e.g. parked in
+    a barrier) wait for THAT application's reply instead of re-applying."""
+
+    __slots__ = ("event", "rep", "arrays")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.rep: dict[str, Any] | None = None
+        self.arrays: Arrays | None = None
+
+
+# Reply-cache bounds: clients serialize requests, so at most one entry per
+# client is ever truly live; small slack absorbs pathological interleavings.
+_DEDUP_PER_CLIENT = 4
+_DEDUP_CLIENTS = 1024
+
+
 class RpcServer:
     """Thread-per-connection TCP server dispatching framed requests to a
     handler (shared by the Coordinator and the shard servers). The handler
-    may raise ``Shutdown`` to stop the server after replying."""
+    may raise ``Shutdown`` to stop the server after replying.
+
+    Requests carrying a client id + sequence number are deduplicated
+    through a per-client reply cache (see module docstring). A
+    :class:`~parameter_server_tpu.parallel.chaos.FaultPlan` may be armed —
+    explicitly or via the ``PS_FAULT_PLAN`` env var — to perturb received
+    frames for recovery testing."""
 
     class Shutdown(Exception):
         pass
@@ -123,8 +162,20 @@ class RpcServer:
         handler: Callable[[dict[str, Any], Arrays], tuple[dict[str, Any], Arrays]],
         host: str = "127.0.0.1",
         port: int = 0,
+        fault_plan: FaultPlan | None = None,
+        idempotent_cmds: frozenset[str] = frozenset(),
+        expose_identity: bool = False,
     ):
         self._handler = handler
+        # re-applying these is harmless, so resends bypass the reply cache
+        # entirely — caching their (potentially large: pull/dump/kv_get
+        # payloads) replies would pin the arrays of the last
+        # _DEDUP_PER_CLIENT requests per client for no correctness gain
+        self._idempotent_cmds = idempotent_cmds
+        # hand the deduped (cid, seq) identity to the handler (as _cid/_seq
+        # header fields) so it can keep its own durable dedup ledger — the
+        # shard server persists applied push seqs into its checkpoint
+        self._expose_identity = expose_identity
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -133,8 +184,14 @@ class RpcServer:
         self._stop = threading.Event()
         self.bytes_in = 0
         self.bytes_out = 0
+        self.frames_in = 0
         self._counter_lock = threading.Lock()  # counters shared by conn threads
         self._accept_thread: threading.Thread | None = None
+        self._conns: set[socket.socket] = set()  # live, for stop() to sever
+        # cid -> (seq -> _DedupEntry), both LRU-bounded
+        self._dedup: OrderedDict[str, OrderedDict[int, _DedupEntry]] = OrderedDict()
+        self._dedup_lock = threading.Lock()
+        self.fault_plan = fault_plan if fault_plan is not None else FaultPlan.from_env()
 
     def start(self) -> "RpcServer":
         self._accept_thread = threading.Thread(target=self._accept, daemon=True)
@@ -151,75 +208,295 @@ class RpcServer:
 
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._counter_lock:
+            self._conns.add(conn)
+        # register-then-check pairs with stop()'s set-then-sever: a conn
+        # accepted concurrently with stop() is either seen by the sweep
+        # above or bails here — it can never serve a stopped server
+        if self._stop.is_set():
+            with self._counter_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
         try:
             while True:
                 header, arrays, nbytes = recv_frame_sized(conn)
                 with self._counter_lock:
                     self.bytes_in += nbytes
+                    self.frames_in += 1
+                fault = (
+                    self.fault_plan.decide(header.get("cmd", ""))
+                    if self.fault_plan is not None
+                    else None
+                )
+                if fault is not None and fault.action == "drop":
+                    return  # request lost before it applied; conn closed below
+                if fault is not None and fault.action == "delay":
+                    time.sleep(fault.delay_s)
+                cid = header.pop("_cid", None)
+                seq = header.pop("_seq", None)
+                # copy BEFORE dispatch: handlers mutate the header (pop cmd)
+                dup_header = (
+                    dict(header)
+                    if fault is not None and fault.action == "duplicate"
+                    else None
+                )
                 try:
-                    rep, rep_arrays = self._handler(header, arrays)
+                    rep, rep_arrays = self._dispatch(cid, seq, header, arrays)
+                    if dup_header is not None:
+                        # the same frame delivered twice: without dedup this
+                        # double-applies (reply of the copy is discarded)
+                        self._dispatch(cid, seq, dup_header, arrays)
                 except RpcServer.Shutdown:
-                    send_frame(conn, {"ok": True})
-                    self.stop()
+                    try:
+                        send_frame(conn, {"ok": True})
+                    finally:
+                        # stop() even when the ack send fails: the reply
+                        # cache would answer a resent shutdown without
+                        # re-running the handler, so nothing would ever
+                        # stop the server (shutdown is the one command
+                        # whose side effect happens after the reply)
+                        self.stop()
                     return
-                except Exception as e:  # surface handler errors to the caller
-                    rep, rep_arrays = {"ok": False, "error": repr(e)}, {}
+                if fault is not None and fault.action == "disconnect":
+                    return  # applied, but the reply is lost; conn closed below
                 sent = send_frame(conn, rep, rep_arrays)
                 with self._counter_lock:
                     self.bytes_out += sent
         except (ConnectionError, OSError):
             return  # client went away; its requests died with it
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._counter_lock:
+                self._conns.discard(conn)
+
+    def _dispatch(
+        self, cid: str | None, seq: int | None, header: dict[str, Any], arrays: Arrays
+    ) -> tuple[dict[str, Any], Arrays]:
+        """Apply-or-replay: the first delivery of (cid, seq) runs the
+        handler and caches its reply; every later delivery returns that
+        cached reply (waiting for it if the first is still in flight)."""
+        if cid is None or seq is None:  # legacy/raw frame: no dedup contract
+            return self._apply(header, arrays)
+        if header.get("cmd") in self._idempotent_cmds:
+            return self._apply(header, arrays)  # re-apply beats caching
+        if self._expose_identity:
+            header["_cid"], header["_seq"] = cid, seq
+        with self._dedup_lock:
+            per = self._dedup.get(cid)
+            if per is None:
+                per = self._dedup[cid] = OrderedDict()
+                while len(self._dedup) > _DEDUP_CLIENTS:
+                    self._dedup.popitem(last=False)
+            else:
+                self._dedup.move_to_end(cid)
+            ent = per.get(seq)
+            owner = ent is None
+            if owner:
+                ent = per[seq] = _DedupEntry()
+                while len(per) > _DEDUP_PER_CLIENT:
+                    per.popitem(last=False)
+        if not owner:
+            ent.event.wait()  # may park on a blocking command's first apply
+            wire_counters.inc("rpc_dedup_hits")
+            return ent.rep, ent.arrays  # type: ignore[return-value]
+        try:
+            rep, rep_arrays = self._apply(header, arrays)
+        except RpcServer.Shutdown:
+            # cache the ack a resend would expect, then let _serve stop us
+            ent.rep, ent.arrays = {"ok": True}, {}
+            ent.event.set()
+            raise
+        if rep.get("_transient"):
+            # did-not-commit reply (e.g. the shard server's need_keys
+            # bounce): nothing was applied, so a later delivery of this
+            # SAME (cid, seq) must re-run the handler, not replay this
+            # bounce — drop the entry instead of caching it. This is what
+            # lets one logical mutation keep one dedup identity across
+            # the key-caching protocol's two-phase exchange.
+            with self._dedup_lock:
+                per = self._dedup.get(cid)
+                if per is not None and per.get(seq) is ent:
+                    del per[seq]
+        ent.rep, ent.arrays = rep, rep_arrays
+        ent.event.set()
+        return rep, rep_arrays
+
+    def _apply(
+        self, header: dict[str, Any], arrays: Arrays
+    ) -> tuple[dict[str, Any], Arrays]:
+        try:
+            return self._handler(header, arrays)
+        except RpcServer.Shutdown:
+            raise
+        except Exception as e:  # surface handler errors to the caller
+            return {"ok": False, "error": repr(e)}, {}
+
+    def fault_stats(self) -> dict[str, int] | None:
+        """Armed plan's fire counts (None when no plan is armed)."""
+        return None if self.fault_plan is None else self.fault_plan.stats()
 
     def stop(self) -> None:
         self._stop.set()
+        # shutdown BEFORE close: the accept thread parked in accept() holds
+        # the open file description, so a bare close() leaves the kernel
+        # socket listening forever — the port could never be rebound by a
+        # restarted server and stop() would not actually stop accepting
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        # sever live connections: a stopped server must look DEAD to its
+        # clients (their self-healing reconnect logic owns what happens
+        # next), not leave them parked on a half-alive socket
+        with self._counter_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 class RpcClient:
     """One persistent connection; requests are serialized under a lock
-    (the reference's per-remote-node send queue discipline)."""
+    (the reference's per-remote-node send queue discipline).
 
-    def __init__(self, address: str, retries: int = 50, retry_delay: float = 0.1):
-        host, port = address.rsplit(":", 1)
+    Self-healing: every request carries this client's id and a sequence
+    number. A mid-call ``OSError``/truncated frame triggers transparent
+    reconnect (exponential backoff + jitter, bounded by
+    ``reconnect_timeout_s``) and a resend of the SAME sequence number — the
+    server's reply cache makes the retry exactly-once even for
+    non-idempotent commands. The window only bounds time spent *retrying
+    after a failure*; a healthy blocking call (barrier, ssp_wait) may park
+    indefinitely as before."""
+
+    def __init__(
+        self,
+        address: str,
+        retries: int = 50,
+        retry_delay: float = 0.1,
+        reconnect_timeout_s: float = 30.0,
+        cid: str | None = None,
+        start_seq: int = 0,
+    ):
+        """``cid``/``start_seq`` transfer a logical client identity into a
+        rebuilt connection (ServerHandle recovery): the server's dedup
+        state is keyed by cid, so a resend after the rebuild is only
+        recognized if the identity survives. ``start_seq`` must clear the
+        old client's counter or fresh requests would collide with (and be
+        swallowed by) cached replies of old sequence numbers."""
+        self._address = address
+        self._cid = cid or uuid.uuid4().hex[:16]
+        self._next_seq = start_seq
+        self._reconnect_timeout_s = reconnect_timeout_s
+        self._rng = random.Random()  # backoff jitter: no determinism contract
+        self._lock = threading.Lock()
+        self._closed = False
+        self.bytes_out = 0
+        self.bytes_in = 0
         last: Exception | None = None
         for _ in range(retries):
             try:
-                self._sock = socket.create_connection((host, int(port)), timeout=30)
+                self._sock: socket.socket | None = self._connect()
                 break
             except OSError as e:  # server may still be binding
                 last = e
                 time.sleep(retry_delay)
         else:
             raise ConnectionError(f"cannot reach {address}: {last}")
+
+    def _connect(self) -> socket.socket:
+        host, port = self._address.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=30)
         # blocking calls (barrier, ssp_wait) may legitimately park for longer
         # than any fixed socket timeout; request-level timeouts are carried in
         # the header and enforced server-side, the launcher is the backstop
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._lock = threading.Lock()
-        self.bytes_out = 0
-        self.bytes_in = 0
+        s.settimeout(None)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
 
     def call(
-        self, cmd: str, arrays: Arrays | None = None, **fields: Any
+        self, cmd: str, arrays: Arrays | None = None, *, _retry: bool = True,
+        _seq: int | str | None = None, **fields: Any,
     ) -> tuple[dict[str, Any], Arrays]:
-        header = {"cmd": cmd, **fields}
+        """``_seq`` overrides the auto-allocated sequence number: a caller
+        that re-issues a logical request across *rebuilt* clients (e.g.
+        ``ServerHandle._keyed_call``) passes the same value each time so
+        every delivery is one dedup identity. Caller-owned seqs must live
+        in a disjoint namespace (the handle uses ``"k<n>"`` strings) so
+        they can never collide with the internal integer counter."""
         with self._lock:
-            self.bytes_out += send_frame(self._sock, header, arrays)
-            rep, rep_arrays, nbytes = recv_frame_sized(self._sock)
-            self.bytes_in += nbytes
+            if _seq is None:
+                _seq = self._next_seq
+                self._next_seq += 1
+            header = {"cmd": cmd, "_cid": self._cid, "_seq": _seq, **fields}
+            rep, rep_arrays = self._call_locked(header, arrays, _retry)
         if not rep.get("ok", True):
             raise RuntimeError(f"{cmd} failed remotely: {rep.get('error')}")
         return rep, rep_arrays
 
+    def _call_locked(
+        self, header: dict[str, Any], arrays: Arrays | None, retry: bool
+    ) -> tuple[dict[str, Any], Arrays]:
+        attempt = 0
+        deadline = time.monotonic() + self._reconnect_timeout_s
+        while True:
+            try:
+                if self._closed:
+                    raise ConnectionError(f"client to {self._address} is closed")
+                if self._sock is None:
+                    self._sock = self._connect()
+                    wire_counters.inc("rpc_reconnects")
+                self.bytes_out += send_frame(self._sock, header, arrays)
+                rep, rep_arrays, nbytes = recv_frame_sized(self._sock)
+                self.bytes_in += nbytes
+                return rep, rep_arrays
+            except (ConnectionError, OSError):
+                self._drop_sock()
+                if self._closed or not retry or time.monotonic() >= deadline:
+                    raise
+                wire_counters.inc("rpc_retries")
+                # exponential backoff + jitter: a server resetting every
+                # connect must not be hammered at full speed, and lockstep
+                # clients must not reconnect in synchronized waves
+                delay = min(0.05 * (1 << min(attempt, 6)), 2.0)
+                delay *= 0.5 + self._rng.random()
+                time.sleep(min(delay, max(deadline - time.monotonic(), 0.0)))
+                attempt += 1
+
+    @property
+    def identity(self) -> tuple[str, int]:
+        """(cid, next unused internal seq) — transfer into a replacement
+        client (``RpcClient(..., cid=, start_seq=)``) so the server's
+        dedup state keeps recognizing the logical caller across rebuilds."""
+        return self._cid, self._next_seq
+
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._closed = True  # no reconnects on behalf of a closed client
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
 
 
 class Coordinator:
@@ -229,6 +506,12 @@ class Coordinator:
     the workload pool, merged progress, heartbeats, and the SSP clock.
     All commands are served by ``RpcServer`` threads; blocking commands
     (barrier / blocking kv_get / ssp_wait) park the connection's thread.
+
+    Self-healing control plane: ``start_recovery`` runs a sweep thread that
+    promotes ``HeartbeatMonitor.dead()`` into ``WorkloadPool.
+    reassign_worker`` + SSP-clock release, so a dead worker's tasks drain
+    onto survivors without any scheduler-side polling logic (ref: the
+    scheduler's dead-node handling driving recovery).
     """
 
     def __init__(
@@ -236,6 +519,8 @@ class Coordinator:
         host: str = "127.0.0.1",
         port: int = 0,
         heartbeat_timeout_s: float = 30.0,
+        recovery_interval_s: float = 0.0,
+        fault_plan: FaultPlan | None = None,
     ):
         self._nodes: dict[int, dict[str, Any]] = {}
         self._next_id = 0
@@ -246,8 +531,67 @@ class Coordinator:
         self._monitor = HeartbeatMonitor(heartbeat_timeout_s)
         self._clock: SSPClock | None = None
         self._cv = threading.Condition()
-        self.server = RpcServer(self._handle, host, port).start()
+        self._recovered: dict[int, dict[str, Any]] = {}  # worker rank -> info
+        self._sweep_stop = threading.Event()
+        self._sweep_thread: threading.Thread | None = None
+        self.server = RpcServer(
+            self._handle, host, port, fault_plan=fault_plan,
+            # reads and last-writer-wins/monotonic writes: re-applying a
+            # resend is harmless, and kv_get replies can carry model-sized
+            # blobs that must not be pinned in the reply cache
+            idempotent_cmds=frozenset({
+                "kv_get", "kv_set", "nodes", "beat", "progress",
+                "progress_merged", "workload_stats", "ssp_progress",
+            }),
+        )
+        self.server.start()
         self.address = self.server.address
+        if recovery_interval_s > 0:
+            self.start_recovery(recovery_interval_s)
+
+    # -- recovery sweep --------------------------------------------------
+
+    def start_recovery(self, interval_s: float = 0.5) -> None:
+        """Arm the dead-node sweep (idempotent): every ``interval_s`` the
+        monitor's overdue workers have their workloads requeued and their
+        SSP clock retired, so surviving workers drain their tasks."""
+        if self._sweep_thread is not None:
+            return
+        def sweep() -> None:
+            while not self._sweep_stop.wait(interval_s):
+                self._sweep_once()
+        self._sweep_thread = threading.Thread(target=sweep, daemon=True)
+        self._sweep_thread.start()
+
+    def _sweep_once(self) -> None:
+        for nid in self._monitor.dead():
+            with self._cv:
+                info = dict(self._nodes.get(nid, {}))
+            if info.get("role") != "worker" or "rank" not in info:
+                continue  # dead servers are the scheduler's call (grace /
+                # checkpoint-restart policy lives there, not here)
+            rank = int(info["rank"])
+            with self._cv:
+                finished = f"worker_done/{rank}" in self._kv
+            if finished:
+                # clean completion: drop the corpse so dead() stays the
+                # actionable list
+                self._monitor.forget(nid)
+                continue
+            # no handled-before guard: forget(nid) below keeps a handled
+            # death out of dead(), and a forgotten node only reappears
+            # through a fresh beat — i.e. it was ALIVE again (restarted
+            # rank or falsely-declared-dead straggler) and may hold fresh
+            # workloads, so its next death must be recovered again too.
+            # A second recovery of a rank overwrites its report entry.
+            requeued = self._pool.reassign_worker(rank) if self._pool else []
+            if self._clock is not None:
+                self._clock.retire(rank)
+            with self._cv:
+                self._recovered[rank] = {"node_id": nid, "requeued": requeued}
+                self._cv.notify_all()
+            self._monitor.forget(nid)
+            wire_counters.inc("workers_recovered")
 
     # -- dispatch --------------------------------------------------------
 
@@ -374,6 +718,16 @@ class Coordinator:
     def _cmd_dead(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
         return {"ok": True, "dead": self._monitor.dead(), "alive": self._monitor.alive()}, {}
 
+    def _cmd_recovered(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        """Worker ranks the recovery sweep has already handled (requeued +
+        clock-retired); the scheduler merges these instead of running its
+        own dead-worker logic."""
+        with self._cv:
+            return {
+                "ok": True,
+                "recovered": {str(r): dict(v) for r, v in self._recovered.items()},
+            }, {}
+
     def _cmd_ssp_init(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
         with self._cv:
             if self._clock is None:
@@ -400,6 +754,10 @@ class Coordinator:
         raise RpcServer.Shutdown
 
     def stop(self) -> None:
+        self._sweep_stop.set()
+        if self._sweep_thread is not None:
+            self._sweep_thread.join(timeout=5)
+            self._sweep_thread = None
         self.server.stop()
 
 
@@ -460,6 +818,11 @@ class ControlClient(RpcClient):
     def dead_nodes(self) -> tuple[list[int], list[int]]:
         rep, _ = self.call("dead")
         return rep["dead"], rep["alive"]
+
+    def recovered_workers(self) -> dict[int, dict[str, Any]]:
+        """Worker ranks the coordinator's recovery sweep has handled."""
+        rep, _ = self.call("recovered")
+        return {int(r): v for r, v in rep["recovered"].items()}
 
     def progress(self, worker: int, record: dict[str, Any]) -> None:
         self.call("progress", worker=worker, record=record)

@@ -29,13 +29,14 @@ import hashlib
 import json
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
 import numpy as np
 
 from parameter_server_tpu.kv.updaters import Updater
+from parameter_server_tpu.parallel.chaos import PLAN_ENV, SEED_ENV, FaultPlan
 from parameter_server_tpu.parallel.control import (
     Arrays,
     ControlClient,
@@ -46,6 +47,15 @@ from parameter_server_tpu.parallel.control import (
 from parameter_server_tpu.utils.config import PSConfig
 from parameter_server_tpu.utils.heartbeat import HeartbeatReporter
 from parameter_server_tpu.utils.keyrange import KeyRange
+from parameter_server_tpu.utils.metrics import wire_counters
+
+
+def _plan_from_cfg(cfg: PSConfig) -> FaultPlan | None:
+    """FaultPlan from [fault] fault_plan/fault_seed ("" = rely on the
+    PS_FAULT_PLAN env fallback inside RpcServer)."""
+    if not cfg.fault.fault_plan:
+        return None
+    return FaultPlan.parse(cfg.fault.fault_plan, seed=cfg.fault.fault_seed)
 
 
 def _sig(keys: np.ndarray) -> str:
@@ -112,6 +122,7 @@ class ShardServer:
         host: str = "127.0.0.1",
         port: int = 0,
         advertise_host: str = "",
+        fault_plan: FaultPlan | None = None,
     ):
         import jax.numpy as jnp
 
@@ -124,18 +135,56 @@ class ShardServer:
         self._ctr_lock = threading.Lock()  # counters bumped by conn threads
         self._ckpt_write_lock = threading.Lock()  # one dump writer at a time
         self._ckpt_thread: threading.Thread | None = None
-        self.counters = {"pulls": 0, "pushes": 0, "cache_hits": 0, "need_keys": 0}
+        # durable push dedup: cid -> recently applied push seqs (str-keyed;
+        # seqs normalize through str() so the ledger survives the npz
+        # round-trip). Mutated ONLY under self._lock, in the same critical
+        # section as the state mutation it describes, and checkpointed
+        # with the state — the RpcServer reply cache dies with the
+        # process, so without this a push applied-and-dumped whose reply
+        # was lost to a kill would be re-applied by the restarted server.
+        self._applied_push: OrderedDict[str, OrderedDict[str, None]] = OrderedDict()
+        self.counters = {
+            "pulls": 0, "pushes": 0, "cache_hits": 0, "need_keys": 0,
+            "push_replays": 0,
+        }
         if host in ("0.0.0.0", "::", "") and not advertise_host:
             raise ValueError(
                 "binding a wildcard address requires advertise_host: "
                 "publishing 0.0.0.0 to the coordinator would point remote "
                 "workers at their own loopback"
             )
-        self.server = RpcServer(self._handle, host, port)
+        self.server = RpcServer(
+            self._handle, host, port, fault_plan=fault_plan,
+            # pull/dump/stats re-apply harmlessly — bypassing the reply
+            # cache keeps their row-payload replies from being pinned
+            idempotent_cmds=frozenset({"pull", "dump", "stats"}),
+            expose_identity=True,  # push branch keeps the durable ledger
+        )
         # bind and advertise may differ: bind 0.0.0.0 to accept remote
         # workers, advertise a routable hostname via the coordinator KV
         _, bound_port = self.server.address.rsplit(":", 1)
         self.address = f"{advertise_host or host}:{bound_port}"
+
+    # push-ledger bounds: wider than the reply cache's — entries are tiny
+    # (short strings) and must cover a restart window, not just the last
+    # in-flight call per client
+    _LEDGER_SEQS = 64
+    _LEDGER_CLIENTS = 1024
+
+    def _record_push(self, cid: str, seq: str) -> None:
+        """Record an applied push in the durable dedup ledger. Caller holds
+        ``self._lock``: the record and the state mutation it witnesses must
+        be one atomic unit with respect to ``save_state``'s snapshot."""
+        per = self._applied_push.get(cid)
+        if per is None:
+            per = self._applied_push[cid] = OrderedDict()
+            while len(self._applied_push) > self._LEDGER_CLIENTS:
+                self._applied_push.popitem(last=False)
+        else:
+            self._applied_push.move_to_end(cid)
+        per[seq] = None
+        while len(per) > self._LEDGER_SEQS:
+            per.popitem(last=False)
 
     def _bump(self, name: str) -> None:
         with self._ctr_lock:
@@ -168,11 +217,21 @@ class ShardServer:
 
         with self._lock:
             host = {k: np.asarray(v) for k, v in self.state.items()}
+            # same critical section as the state snapshot: the ledger in a
+            # checkpoint must witness exactly the pushes that checkpoint
+            # contains — never one more, never one fewer
+            ledger = json.dumps(
+                {cid: list(per) for cid, per in self._applied_push.items()}
+            )
         with self._ckpt_write_lock:
             os.makedirs(ckpt_dir, exist_ok=True)
             path = self._ckpt_path(ckpt_dir)
             tmp = path + ".tmp.npz"  # .npz suffix: savez must not append one
-            np.savez(tmp, **host)
+            np.savez(
+                tmp,
+                __push_ledger__=np.frombuffer(ledger.encode(), dtype=np.uint8),
+                **host,
+            )
             os.replace(tmp, path)
 
     def load_state(self, ckpt_dir: str) -> bool:
@@ -184,6 +243,7 @@ class ShardServer:
             return False
         with np.load(path) as z:
             host = {k: z[k] for k in z.files}
+        ledger_raw = host.pop("__push_ledger__", None)
         if set(host) != set(self.state) or any(
             host[k].shape != tuple(self.state[k].shape) for k in host
         ):
@@ -191,8 +251,13 @@ class ShardServer:
                 f"checkpoint {path} does not match this server's state "
                 "layout (different updater or key range?)"
             )
+        applied: OrderedDict[str, OrderedDict[str, None]] = OrderedDict()
+        if ledger_raw is not None:  # absent in pre-ledger checkpoints
+            for cid, seqs in json.loads(ledger_raw.tobytes().decode()).items():
+                applied[cid] = OrderedDict((str(s), None) for s in seqs)
         with self._lock:
             self.state = {k: self._jnp.asarray(v) for k, v in host.items()}
+            self._applied_push = applied
         return True
 
     def start_checkpointing(self, ckpt_dir: str, interval_s: float) -> None:
@@ -243,9 +308,23 @@ class ShardServer:
             self._bump("pulls")
             return {"ok": True, "zip": h.get("zip", False)}, {"w": w.ravel()}
         if cmd == "push":
+            cid = h.get("_cid")
+            seq = None if cid is None else str(h.get("_seq"))
+            if cid is not None:
+                with self._lock:
+                    per = self._applied_push.get(cid)
+                    if per is not None and seq in per:
+                        # this exact push already mutated state in a
+                        # previous server life; its reply died with the
+                        # kill, and the resend must not re-apply
+                        self._bump("push_replays")
+                        wire_counters.inc("rpc_dedup_hits")
+                        return {"ok": True}, {}
             keys = self._resolve_keys(h, arrays)
             if keys is None:
-                return {"ok": True, "need_keys": True}, {}
+                # _transient: nothing committed — the reply cache must NOT
+                # pin this bounce, so the keyed follow-up (same seq) re-runs
+                return {"ok": True, "need_keys": True, "_transient": True}, {}
             g = self._decode_grad(h, arrays).reshape(len(keys), -1)
             with self._lock:
                 rows = {k: v[keys] for k, v in self.state.items()}
@@ -253,6 +332,8 @@ class ShardServer:
                 self.state = {
                     k: self.state[k].at[keys].add(deltas[k]) for k in self.state
                 }
+                if cid is not None:
+                    self._record_push(cid, seq)
             self._bump("pushes")
             return {"ok": True}, {}
         if cmd == "dump":
@@ -262,13 +343,23 @@ class ShardServer:
                 "w": w
             }
         if cmd == "stats":
-            return {
+            rep = {
                 "ok": True,
                 **self.counters,
                 "bytes_out": self.server.bytes_out,
                 "bytes_in": self.server.bytes_in,
+                "frames_in": self.server.frames_in,
                 "cached_sigs": len(self._key_cache),
-            }, {}
+                # recovery observability: resent/duplicated frames this
+                # server answered from the reply cache instead of
+                # re-applying (process-wide counter; one server per
+                # process in the spawned tier)
+                "rpc_dedup_hits": wire_counters.get("rpc_dedup_hits"),
+            }
+            faults = self.server.fault_stats()
+            if faults is not None:
+                rep["faults"] = faults
+            return rep, {}
         if cmd == "shutdown":
             raise RpcServer.Shutdown
         raise ValueError(f"unknown server command {cmd!r}")
@@ -304,7 +395,6 @@ class ServerHandle:
     ):
         import itertools
 
-        self.client = RpcClient(address)
         self.rank = rank
         self.worker = worker
         self._resolve_addr = resolve_addr
@@ -313,6 +403,13 @@ class ServerHandle:
             if reconnect_timeout_s is not None
             else cfg.fault.reconnect_timeout_s
         )
+        # client-internal same-address retry window: short, so transient
+        # connection loss (injected faults, restarts on the same port)
+        # heals in-place with the SAME sequence numbers (dedup-safe), while
+        # a genuinely moved server falls through to the resolver loop in
+        # _keyed_call quickly instead of burning the whole handle window
+        self._client_window_s = min(3.0, self._reconnect_timeout_s)
+        self.client = RpcClient(address, reconnect_timeout_s=self._client_window_s)
         # a worker's pull and in-flight push threads share this handle;
         # concurrent failures must rebuild the connection once — the
         # generation counter lets a late-arriving failing thread see that
@@ -332,6 +429,11 @@ class ServerHandle:
         # atomic: concurrent in-flight push threads must not reuse a
         # stochastic-rounding seed
         self._quant_seed = itertools.count()
+        # logical-call sequence numbers ("k<n>" — a namespace disjoint from
+        # RpcClient's internal integer counter): one per _keyed_call, held
+        # constant across client rebuilds so every delivery of a logical
+        # push is one dedup identity on the server
+        self._kseq = itertools.count()
         if self._codec_bytes:
             from parameter_server_tpu.filters.fixed_point import FixedPointCodec
 
@@ -342,9 +444,10 @@ class ServerHandle:
         doesn't hold it (key-caching filter, worker side). A lost
         connection triggers reconnect-and-retry against the (possibly
         relaunched) server when a resolver was provided."""
+        lseq = f"k{next(self._kseq)}"
         gen = self._conn_gen
         try:
-            return self._keyed_call_once(cmd, keys, arrays, **fields)
+            return self._keyed_call_once(cmd, keys, arrays, lseq, **fields)
         except (ConnectionError, BrokenPipeError, OSError):
             if self._resolve_addr is None:
                 raise
@@ -358,7 +461,7 @@ class ServerHandle:
             self._reconnect(gen, deadline)
             gen = self._conn_gen
             try:
-                return self._keyed_call_once(cmd, keys, arrays, **fields)
+                return self._keyed_call_once(cmd, keys, arrays, lseq, **fields)
             except (ConnectionError, BrokenPipeError, OSError) as e:
                 if time.monotonic() > deadline:
                     raise ConnectionError(
@@ -387,11 +490,20 @@ class ServerHandle:
             if self._conn_gen != failed_gen:
                 return  # a concurrent failure already rebuilt the client
             self.client.close()
+            # the rebuilt client must BE the old one to the server's dedup
+            # machinery: same cid so retried "k<n>" seqs are recognized,
+            # start_seq past the old internal counter so fresh un-keyed
+            # calls (dump/stats) can't collide with cached old replies
+            cid, next_seq = self.client.identity
             last: Exception | None = None
             while time.monotonic() < deadline:
                 try:
                     addr = self._resolve_addr()
-                    self.client = RpcClient(addr, retries=1)
+                    self.client = RpcClient(
+                        addr, retries=1,
+                        reconnect_timeout_s=self._client_window_s,
+                        cid=cid, start_seq=next_seq,
+                    )
                     self._sent_sigs = _LruSigs()
                     self._conn_gen += 1
                     return
@@ -403,7 +515,9 @@ class ServerHandle:
             f"{self._reconnect_timeout_s}s: {last}"
         )
 
-    def _keyed_call_once(self, cmd: str, keys: np.ndarray, arrays: Arrays, **fields):
+    def _keyed_call_once(
+        self, cmd: str, keys: np.ndarray, arrays: Arrays, lseq: str, **fields
+    ):
         sig = _sig(keys)
         send_keys = not (self._key_caching and sig in self._sent_sigs)
         payload = dict(arrays)
@@ -411,13 +525,16 @@ class ServerHandle:
             payload["keys"] = keys.astype(self._key_dtype)
         rep, out = self.client.call(
             cmd, arrays=payload, worker=self.worker, sig=sig,
-            zip=self._zip, **fields,
+            zip=self._zip, _seq=lseq, **fields,
         )
         if rep.get("need_keys"):  # cache miss on a sig we believed was cached
+            # SAME lseq: a need_keys bounce is marked non-committing server
+            # side, so this follow-up re-runs the handler while the logical
+            # mutation keeps a single dedup identity end to end
             payload["keys"] = keys.astype(self._key_dtype)
             rep, out = self.client.call(
                 cmd, arrays=payload, worker=self.worker, sig=sig,
-                zip=self._zip, **fields,
+                zip=self._zip, _seq=lseq, **fields,
             )
         self._sent_sigs.put(sig)
         return rep, out
@@ -481,7 +598,11 @@ class _RemoteBeatSink:
 
     def __init__(self, scheduler: str):
         self._scheduler = scheduler
-        self._ctl: ControlClient | None = ControlClient(scheduler)
+        # short retry window: a beat is periodic — retrying one for longer
+        # than the beat interval just delays the NEXT (fresher) beat
+        self._ctl: ControlClient | None = ControlClient(
+            scheduler, reconnect_timeout_s=1.0
+        )
 
     def beat(self, node_id: int, stats: dict | None = None) -> None:
         # a single transient socket failure must not silence beats forever
@@ -490,7 +611,8 @@ class _RemoteBeatSink:
         try:
             if self._ctl is None:
                 self._ctl = ControlClient(
-                    self._scheduler, retries=1, retry_delay=0.0
+                    self._scheduler, retries=1, retry_delay=0.0,
+                    reconnect_timeout_s=1.0,
                 )
             self._ctl.beat(node_id, stats)
         except Exception:
@@ -544,13 +666,16 @@ def run_server(
         ranges[rank],
         host=bind_host,
         advertise_host=advertise_host,
+        fault_plan=_plan_from_cfg(cfg),
     )
     if ckpt_dir:
         if srv.load_state(ckpt_dir):
             print(f"[server {rank}] resumed from {ckpt_dir}", flush=True)
         if cfg.fault.server_ckpt_interval_s > 0:
             srv.start_checkpointing(ckpt_dir, cfg.fault.server_ckpt_interval_s)
-    ctl = ControlClient(scheduler)
+    ctl = ControlClient(
+        scheduler, reconnect_timeout_s=cfg.fault.reconnect_timeout_s
+    )
     node_id = ctl.register("server", rank=rank)
     # set AFTER any resume: workers re-resolving this key must never beat
     # the state load and pull pre-resume zeros
@@ -601,7 +726,9 @@ def run_worker(
     from parameter_server_tpu.models import metrics as M
     from parameter_server_tpu.ops.sparse import csr_grad, csr_logits, logistic_loss
 
-    ctl = ControlClient(scheduler)
+    ctl = ControlClient(
+        scheduler, reconnect_timeout_s=cfg.fault.reconnect_timeout_s
+    )
     node_id = ctl.register("worker", rank=rank)
     beats = _Beats(scheduler, node_id, cfg.fault.heartbeat_interval_s)
     # the scheduler's ssp_init/workload_init must land before our first
@@ -668,6 +795,10 @@ def run_worker(
                 + ctl.bytes_out,
                 "wire_bytes_in": sum(sh.client.bytes_in for sh in servers)
                 + ctl.bytes_in,
+                # self-healing counters, cumulative for this worker process
+                # (merged at the scheduler as cluster totals)
+                "rpc_retries": wire_counters.get("rpc_retries"),
+                "rpc_reconnects": wire_counters.get("rpc_reconnects"),
             },
         )
         window = []
@@ -756,12 +887,16 @@ def run_scheduler(
     ]
     ctl.workload_init(items)
     ctl.kv_set("scheduler_init_done")  # workers block on this before fetching
+    if cfg.fault.recovery_sweep_interval_s > 0:
+        # dead-WORKER recovery (requeue + clock release) runs inside the
+        # coordinator's sweep thread; this loop just records its verdicts.
+        # Dead-SERVER policy (grace window / fail fast) stays here — it
+        # needs run-level knowledge (checkpointing on? abort or wait?)
+        coordinator.start_recovery(cfg.fault.recovery_sweep_interval_s)
 
     # Monitor loop (ref: the scheduler's dead-node handling): wait until
-    # every worker rank is done or dead; requeue a dead worker's shards and
-    # retire its SSP clock so survivors neither strand its work nor block
-    # on its staleness gate. A plain barrier cannot do this — it would park
-    # forever on the dead worker's missing arrival.
+    # every worker rank is done or dead. A plain barrier cannot do this —
+    # it would park forever on the dead worker's missing arrival.
     dead_ranks: set[int] = set()
     server_dead_since: dict[int, float] = {}  # rank -> first seen dead
     t_start = time.monotonic()
@@ -784,6 +919,15 @@ def run_scheduler(
         }
         if done | dead_ranks >= set(range(num_workers)):
             break
+        for r, info in ctl.recovered_workers().items():
+            if r not in dead_ranks:
+                dead_ranks.add(r)
+                print(
+                    f"[scheduler] worker {r} dead (missed heartbeats); "
+                    f"sweep requeued {len(info['requeued'])} shard(s) and "
+                    "retired its clock",
+                    flush=True,
+                )
         registry = ctl.nodes()
         dead_ids, _alive = ctl.dead_nodes()
         dead_set = {int(x) for x in dead_ids}
@@ -826,6 +970,8 @@ def run_scheduler(
                 continue
             r = int(info.get("rank", -1))
             if r not in dead_ranks and r not in done:
+                # sweep disabled (recovery_sweep_interval_s == 0): fall
+                # back to scheduler-driven recovery over the wire
                 declare_dead(r, "dead (missed heartbeats)")
         if time.monotonic() - t_start > cfg.fault.startup_grace_s:
             # a rank that NEVER registered is in neither the dead list
@@ -853,7 +999,15 @@ def run_scheduler(
         "nnz_w": int(np.count_nonzero(w)),
         "workloads": ctl.workload_stats(),
         "dead_workers": sorted(dead_ranks),
+        # scheduler-process wire/recovery counters; the coordinator runs
+        # in-process, so rpc_dedup_hits here covers every control frame
+        # the cluster resent or duplicated
+        "wire": wire_counters.snapshot(),
     }
+    chaos_stats = coordinator.server.fault_stats()
+    if chaos_stats is not None:
+        out["chaos"] = chaos_stats
+        out["control_frames"] = coordinator.server.frames_in
     if model_out:
         from parameter_server_tpu.utils.checkpoint import dump_weights_text
 
@@ -887,6 +1041,8 @@ def launch_local(
     fault_kill: str = "",
     fault_restart_after: float = -1.0,
     ckpt_dir: str = "",
+    fault_plan: str = "",
+    fault_seed: int = 0,
 ) -> dict[str, Any]:
     """Spawn scheduler + servers + workers as real processes on this host
     (ref: script/local.sh — the de-facto integration test harness).
@@ -905,6 +1061,11 @@ def launch_local(
     ``fault_restart_after >= 0`` respawns the killed node that many seconds
     after the kill — with ``ckpt_dir`` set (server checkpointing, see
     run_server) this exercises the checkpoint-backed server recovery path.
+
+    ``fault_plan`` (parallel/chaos.py spec) arms a seeded FaultPlan on
+    EVERY spawned node's RpcServers via the PS_FAULT_PLAN env var —
+    frame-level drop/delay/disconnect/duplicate chaos on top of (or
+    instead of) the process-kill fault.
     """
     import os
     import socket as socket_mod
@@ -921,6 +1082,10 @@ def launch_local(
         from parameter_server_tpu.utils.hostenv import force_cpu
 
         force_cpu(child_env)
+    if fault_plan:
+        FaultPlan.parse(fault_plan, seed=fault_seed)  # fail fast on a typo
+        child_env[PLAN_ENV] = fault_plan
+        child_env[SEED_ENV] = str(fault_seed)
 
     import tempfile
 
@@ -1065,7 +1230,8 @@ def run_node(
     if role == "scheduler":
         host, port = scheduler.rsplit(":", 1)
         coord = Coordinator(
-            host, int(port), heartbeat_timeout_s=cfg.fault.heartbeat_timeout_s
+            host, int(port), heartbeat_timeout_s=cfg.fault.heartbeat_timeout_s,
+            fault_plan=_plan_from_cfg(cfg),
         )
         return run_scheduler(cfg, coord, num_servers, num_workers, model_out)
     if role == "server":

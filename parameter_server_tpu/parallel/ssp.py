@@ -114,15 +114,27 @@ class SSPClock:
     RETIRED = 1 << 60
 
     def retire(self, worker: int) -> None:
-        """Mark ``worker`` done forever (out of data): it no longer gates
-        the others (ref: a finished worker stops issuing dependencies)."""
+        """Mark ``worker`` done forever (out of data, or declared dead by
+        the recovery sweep): it no longer gates the others (ref: a finished
+        worker stops issuing dependencies). Idempotent, and a late
+        ``finish`` from a falsely-declared-dead worker is absorbed by the
+        monotonic max in ``finish`` — replay-safe both ways."""
         self.finish(worker, self.RETIRED)
 
-    def progress(self) -> dict[str, int]:
+    def is_retired(self, worker: int) -> bool:
+        with self._cv:
+            return self._finished[worker] >= self.RETIRED
+
+    def progress(self) -> dict[str, Any]:
         with self._cv:
             return {
                 "min_finished": self._min_finished(),
                 "max_finished": max(self._finished),
+                # which clocks recovery/drain released — the observable
+                # trace of dead-node handling
+                "retired": [
+                    w for w, f in enumerate(self._finished) if f >= self.RETIRED
+                ],
             }
 
     def state_dict(self) -> dict:

@@ -338,9 +338,15 @@ class PodTrainer:
         its protocol) at ``coordinator``; EVERY process calls this with
         the same file list. Epochs ride the pool as distinct items."""
         from parameter_server_tpu.parallel.control import ControlClient
+        from parameter_server_tpu.utils.metrics import wire_counters
 
         cfg = self.cfg
-        ctl = ControlClient(coordinator)
+        # self-healing client: a coordinator restart or injected control-
+        # plane fault mid-run is absorbed by reconnect + resend (the
+        # server-side reply cache keeps workload_fetch exactly-once)
+        ctl = ControlClient(
+            coordinator, reconnect_timeout_s=cfg.fault.reconnect_timeout_s
+        )
         try:
             items = [
                 f"{e}:{f}"
@@ -373,7 +379,12 @@ class PodTrainer:
                 for w in range(self.local_data_shards)
             ]
             with self._trace_cm():
-                return self._train_epoch(streams, report_every)
+                out = dict(self._train_epoch(streams, report_every) or {})
+            # recovery observability for the pod path (cumulative for this
+            # process; mostly zero on a healthy wire)
+            out["rpc_retries"] = wire_counters.get("rpc_retries")
+            out["rpc_reconnects"] = wire_counters.get("rpc_reconnects")
+            return out
         finally:
             ctl.close()
 

@@ -25,15 +25,21 @@ class WorkloadPool:
         self._pending: list[str] = list(workloads)
         self._active: dict[str, _Assignment] = {}
         self._done: set[str] = set()
+        self._attempts: dict[str, int] = {}  # workload -> times handed out
+        self._reassigned = 0
         self._lock = threading.Lock()
 
     def fetch(self, worker: int) -> str | None:
-        """Next workload for ``worker``; None when nothing is pending."""
+        """Next workload for ``worker``; None when nothing is pending.
+        Pop and assignment are one atomic step under the lock: two workers
+        racing for a reassigned workload can never both become its owner
+        (``_active`` is keyed by workload — one assignment at a time)."""
         with self._lock:
             if not self._pending:
                 return None
             w = self._pending.pop(0)
             self._active[w] = _Assignment(w, worker)
+            self._attempts[w] = self._attempts.get(w, 0) + 1
             return w
 
     def finish(self, workload: str) -> None:
@@ -51,32 +57,55 @@ class WorkloadPool:
 
     def reassign_stragglers(self, older_than_s: float) -> list[str]:
         """Requeue workloads assigned longer than ``older_than_s`` ago
-        (ref: straggler / dead-worker reassignment)."""
+        (ref: straggler / dead-worker reassignment). Requeued work goes to
+        the FRONT of the queue: recovery drains the stranded tasks before
+        untouched pending ones."""
         now = time.monotonic()
         requeued = []
         with self._lock:
             for w, a in list(self._active.items()):
                 if now - a.t_assigned > older_than_s:
                     del self._active[w]
-                    self._pending.append(w)
                     requeued.append(w)
+            self._pending[:0] = requeued
+            self._reassigned += len(requeued)
         return requeued
 
     def reassign_worker(self, worker: int) -> list[str]:
-        """Requeue everything held by a dead worker."""
+        """Requeue everything held by a dead worker (front of the queue,
+        like reassign_stragglers)."""
         requeued = []
         with self._lock:
             for w, a in list(self._active.items()):
                 if a.worker == worker:
                     del self._active[w]
-                    self._pending.append(w)
                     requeued.append(w)
+            self._pending[:0] = requeued
+            self._reassigned += len(requeued)
         return requeued
+
+    def owner_of(self, workload: str) -> int | None:
+        """Current owner rank, or None when not active (observability +
+        the reassign-race tests' single-owner assertion)."""
+        with self._lock:
+            a = self._active.get(workload)
+            return None if a is None else a.worker
+
+    def attempts(self, workload: str) -> int:
+        """How many times ``workload`` has been handed out (1 = never
+        reassigned)."""
+        with self._lock:
+            return self._attempts.get(workload, 0)
 
     @property
     def all_done(self) -> bool:
         with self._lock:
             return not self._pending and not self._active
+
+    @property
+    def reassigned_total(self) -> int:
+        with self._lock:
+            return self._reassigned
 
     def stats(self) -> dict[str, int]:
         with self._lock:
@@ -84,4 +113,10 @@ class WorkloadPool:
                 "pending": len(self._pending),
                 "active": len(self._active),
                 "done": len(self._done),
+                # exactly-once ledger: every hand-out either completed or
+                # was requeued, so attempts == done + reassigned at the end
+                # of a healthy run — a double-applied (non-deduped) fetch
+                # breaks this invariant visibly
+                "attempts": sum(self._attempts.values()),
+                "reassigned": self._reassigned,
             }

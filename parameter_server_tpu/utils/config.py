@@ -205,6 +205,15 @@ class FaultConfig:
     # seconds for a relaunched server to re-register from its checkpoint
     server_restart_grace_s: float = 0.0
     reconnect_timeout_s: float = 60.0  # worker retry window per lost server
+    # coordinator recovery sweep: dead workers' shards requeued + SSP clock
+    # retired every this many seconds (0 disables the sweep thread)
+    recovery_sweep_interval_s: float = 0.5
+    # fault injection (parallel/chaos.py): a FaultPlan spec armed on every
+    # RpcServer this config spawns (coordinator + shard servers); "" = off.
+    # The PS_FAULT_PLAN / PS_FAULT_SEED env vars arm the same plans on
+    # processes this config never reaches (spawned children).
+    fault_plan: str = ""
+    fault_seed: int = 0
 
 
 @dataclass
@@ -272,7 +281,16 @@ def load_config(path: str | Path) -> PSConfig:
     """Load a PSConfig from a .json or .toml file."""
     p = Path(path)
     if p.suffix == ".toml":
-        import tomllib
+        try:
+            import tomllib  # stdlib, python >= 3.11
+        except ModuleNotFoundError:
+            try:
+                import tomli as tomllib  # the stdlib module's upstream
+            except ModuleNotFoundError:
+                # last resort on dep-frozen 3.10 images: pip vendors the
+                # same tomli; prefer a fragile import to losing .toml
+                # support entirely
+                from pip._vendor import tomli as tomllib
 
         d = tomllib.loads(p.read_text())
     else:

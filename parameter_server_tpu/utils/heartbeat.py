@@ -67,6 +67,15 @@ class HeartbeatMonitor:
                 n for n, b in self._beats.items() if now - b["t"] > self.timeout_s
             )
 
+    def forget(self, node_id: int) -> None:
+        """Drop a node's record once its death has been *handled* (workloads
+        requeued, clock retired) or it finished cleanly: ``dead()`` stays
+        the actionable list instead of accumulating corpses that would
+        re-trigger recovery every sweep. A late beat from a falsely-flagged
+        node simply re-registers it."""
+        with self._lock:
+            self._beats.pop(node_id, None)
+
     def dashboard(self) -> str:
         """The scheduler's cluster table (ref: dashboard printout)."""
         now = time.monotonic()

@@ -1,0 +1,29 @@
+"""Version-compat shims over the moving parts of the jax API surface.
+
+The SPMD tier targets the modern public API (``jax.shard_map`` with the
+``check_vma`` kwarg); older interpreters in the 0.4.x line ship the same
+machinery as ``jax.experimental.shard_map.shard_map`` with the kwarg named
+``check_rep``. The host control plane must not become uninstallable over a
+spelling drift in an API we use identically either way, so every in-repo
+``shard_map`` import routes through here.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # modern public API (jax >= ~0.6)
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # 0.4.x line: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_HAS_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, /, **kw):
+    """``jax.shard_map`` under either spelling of the replication-check
+    kwarg (``check_vma`` new, ``check_rep`` old); call sites use the new
+    name."""
+    if "check_vma" in kw and not _HAS_VMA:
+        kw["check_rep"] = kw.pop("check_vma")
+    return _shard_map(f, **kw)

@@ -11,9 +11,48 @@ workers contribute dicts, process 0 prints the table and appends JSONL.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from pathlib import Path
 from typing import Any
+
+
+class CounterSet:
+    """Thread-safe named monotonic counters (ref: the Postoffice per-node
+    counter tables). One process-global instance, ``wire_counters``, is the
+    observability spine of the self-healing control plane: RpcClient bumps
+    ``rpc_retries``/``rpc_reconnects`` on every mid-call failure it
+    absorbs, RpcServer bumps ``rpc_dedup_hits`` when the reply cache
+    suppresses a resent/duplicated non-idempotent command, and the chaos
+    layer bumps ``fault_<action>`` per injected fault — so a recovery test
+    can assert not just that a run survived but that the machinery it
+    claims to test actually engaged."""
+
+    def __init__(self) -> None:
+        self._d: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._d[name] = self._d.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._d.get(name, 0)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._d)
+
+    def reset(self) -> None:
+        """Zero everything (tests only: production counters are cumulative
+        for the life of the process, like the reference's)."""
+        with self._lock:
+            self._d.clear()
+
+
+#: process-global wire/recovery counters (see CounterSet docstring)
+wire_counters = CounterSet()
 
 
 class Timer:
@@ -115,6 +154,11 @@ def merge_progress(reports: list[dict[str, Any]]) -> dict[str, Any]:
         "wire_bytes_out",
         "wire_bytes_in",
         "est_collective_bytes",
+        # self-healing control plane (each worker reports its cumulative
+        # wire_counters; the merge is the cluster total)
+        "rpc_retries",
+        "rpc_reconnects",
+        "rpc_dedup_hits",
     ):
         vals = [r[k] for r in reports if k in r]
         if vals:

@@ -235,11 +235,20 @@ def test_dynamic_pool_composes_tiers(tmp_path, bucket_nnz):
     cfg["data"]["bucket_nnz"] = bucket_nnz
     (tmp_path / "app.json").write_text(json.dumps(cfg))
 
+    from parameter_server_tpu.parallel.chaos import PLAN_ENV, SEED_ENV
     from parameter_server_tpu.utils.hostenv import force_cpu
 
     env = force_cpu(dict(os.environ))
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    # arm a seeded fault plan on the pool Coordinator child 0 hosts (env is
+    # how spawned processes arm chaos): lost replies and duplicated frames
+    # on the REAL multi-process wire; the exactly-once assertions below
+    # hold only because reconnect + reply-cache dedup absorb them
+    env[PLAN_ENV] = (
+        "disconnect,prob=0.04;duplicate,prob=0.04;delay,prob=0.05,delay_s=0.005"
+    )
+    env[SEED_ENV] = "97"
     jax_coord = f"127.0.0.1:{_free_port()}"
     pool_coord = f"127.0.0.1:{_free_port()}"
     child = str(REPO / "tests" / "_multihost_pool_child.py")
@@ -265,9 +274,12 @@ def test_dynamic_pool_composes_tiers(tmp_path, bucket_nnz):
         outs.append(json.loads(line[len("RESULT "):]))
 
     by_pid = {o["pid"]: o for o in outs}
-    # every (epoch, file) item finished exactly once pod-wide
+    # every (epoch, file) item finished exactly once pod-wide — and the
+    # attempts ledger proves no fetch was double-applied under the armed
+    # fault plan (a resent fetch that re-popped would inflate attempts)
     assert by_pid[0]["pool"] == {
         "pending": 0, "active": 0, "done": 4 * n_epochs,
+        "attempts": 4 * n_epochs, "reassigned": 0,
     }, by_pid
     # dynamic assignment still feeds the FULL corpus exactly once per epoch
     total = by_pid[0]["examples_seen"] + by_pid[1]["examples_seen"]

@@ -294,6 +294,92 @@ class TestShardServer:
         assert err < 0.1 * scale
 
 
+class TestDurablePushDedup:
+    """Exactly-once pushes across server LIVES: the reply cache dies with
+    the process, so a push that was applied and checkpointed but whose
+    reply was lost to a kill must be recognized by the restarted server's
+    durable ledger instead of re-applied."""
+
+    def _mk(self):
+        from parameter_server_tpu.models.linear import updater_from_config
+
+        cfg = _mini_cfg(num_keys=16)
+        return ShardServer(updater_from_config(cfg), KeyRange(0, 16))
+
+    def _push_header(self, seq, cid="worker-0"):
+        return {
+            "cmd": "push", "worker": 0, "sig": "s", "codec": 0,
+            "_cid": cid, "_seq": seq,
+        }
+
+    def _state(self, srv):
+        return {k: np.asarray(v).copy() for k, v in srv.state.items()}
+
+    def test_ledger_survives_checkpoint_and_skips_replay(self, tmp_path):
+        arrays = {
+            "keys": np.array([1, 2], dtype=np.uint32),
+            "g": np.array([5.0, -2.5], dtype=np.float32),
+        }
+        srv1 = self._mk()
+        try:
+            rep, _ = srv1._handle(self._push_header("k0"), dict(arrays))
+            assert rep == {"ok": True}
+            srv1.save_state(str(tmp_path))
+            s1 = self._state(srv1)
+        finally:
+            srv1.server.stop()
+        srv2 = self._mk()
+        try:
+            assert srv2.load_state(str(tmp_path))
+            # replay of the SAME (cid, seq): srv1's reply cache is gone —
+            # only the checkpointed ledger can stop the double-apply
+            rep, _ = srv2._handle(self._push_header("k0"), dict(arrays))
+            assert rep == {"ok": True}
+            assert srv2.counters["push_replays"] == 1
+            assert srv2.counters["pushes"] == 0
+            for k, v in self._state(srv2).items():
+                np.testing.assert_array_equal(v, s1[k])
+            # a FRESH seq from the same client applies normally
+            rep, _ = srv2._handle(self._push_header("k1"), dict(arrays))
+            assert rep == {"ok": True}
+            assert srv2.counters["pushes"] == 1
+            assert any(
+                not np.array_equal(v, s1[k])
+                for k, v in self._state(srv2).items()
+            )
+        finally:
+            srv2.server.stop()
+
+    def test_need_keys_bounce_not_cached_same_seq_applies(self):
+        """The key-caching two-phase exchange under one dedup identity: the
+        need_keys bounce is non-committing (not pinned in the reply cache),
+        the keyed follow-up with the SAME seq applies, and a resend of the
+        applied push replays instead of re-applying."""
+        from parameter_server_tpu.parallel.control import RpcClient
+
+        srv = self._mk().start()
+        g = {"g": np.array([1.0, 1.0], dtype=np.float32)}
+        keyed = {"keys": np.array([1, 2], dtype=np.uint32), **g}
+        cli = RpcClient(srv.address)
+        try:
+            rep, _ = cli.call("push", arrays=g, worker=0, sig="s", codec=0,
+                              _seq="p0")
+            assert rep.get("need_keys")
+            assert srv.counters["pushes"] == 0
+            rep, _ = cli.call("push", arrays=keyed, worker=0, sig="s",
+                              codec=0, _seq="p0")
+            assert "need_keys" not in rep
+            assert srv.counters["pushes"] == 1
+            # resend of the applied push: answered from the reply cache
+            rep, _ = cli.call("push", arrays=keyed, worker=0, sig="s",
+                              codec=0, _seq="p0")
+            assert rep["ok"]
+            assert srv.counters["pushes"] == 1
+        finally:
+            cli.close()
+            srv.server.stop()
+
+
 @pytest.mark.slow
 class TestLaunchLocal:
     """The reference's local.sh run, for real: 1 scheduler + 2 servers +
@@ -346,7 +432,10 @@ class TestLaunchLocal:
         for st in out["server_stats"]:
             assert st["pushes"] > 0 and st["pulls"] > 0
         # nothing stranded, nobody died
-        assert out["workloads"] == {"pending": 0, "active": 0, "done": 12}
+        assert out["workloads"] == {
+            "pending": 0, "active": 0, "done": 12,
+            "attempts": 12, "reassigned": 0,  # each shard handed out once
+        }
         assert out["dead_workers"] == []
 
     def test_worker_killed_mid_run_recovers(self, tmp_path, rng):
@@ -394,10 +483,12 @@ class TestLaunchLocal:
             timeout=420, fault_kill="worker:1@1.5",
         )
         assert out["dead_workers"] == [1], out
-        # every workload finished despite the death — requeue worked
-        assert out["workloads"] == {
-            "pending": 0, "active": 0, "done": 4 * n_epochs,
-        }, out
+        # every workload finished despite the death — requeue worked; the
+        # attempts ledger balances (each hand-out completed or was requeued
+        # exactly once: no lost shard, no double assignment)
+        wl = out["workloads"]
+        assert (wl["pending"], wl["active"], wl["done"]) == (0, 0, 4 * n_epochs), out
+        assert wl["attempts"] == wl["done"] + wl["reassigned"], out
         assert out["val_auc"] > 0.85, out
 
 
@@ -521,8 +612,104 @@ class TestServerRecovery:
         assert out["dead_workers"] == [], out
         assert out["workloads"] == {
             "pending": 0, "active": 0, "done": 4 * n_epochs,
+            "attempts": 4 * n_epochs, "reassigned": 0,
         }, out
         # quality parity with the no-fault run of this family (>0.85):
         # a sub-checkpoint-interval slice of rank 1's pushes may be lost
+        assert out["val_auc"] > 0.83, out
+        assert out["nnz_w"] > 0
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    """The headline recovery drill (ISSUE 1 acceptance): SIGKILL + restart
+    a shard server mid-training WHILE a seeded FaultPlan drops/delays well
+    over 5% of control frames (plus lost replies and duplicated frames) on
+    every RpcServer in the process tree. The run must still converge to
+    the no-fault objective (within the checkpoint-restart tolerance), with
+    zero double-applied workload_fetch effects and the retry/reconnect/
+    dedup counters proving the self-healing machinery actually engaged."""
+
+    def test_server_kill_plus_frame_chaos_converges(self, tmp_path, rng):
+        from parameter_server_tpu.data.synthetic import make_sparse_logistic, write_libsvm
+        from parameter_server_tpu.parallel.multislice import launch_local
+
+        labels, keys, vals, _ = make_sparse_logistic(
+            3000, 800, nnz_per_example=10, noise=0.3, seed=17
+        )
+        files = []
+        for i in range(4):
+            sl = slice(i * 700, (i + 1) * 700)
+            f = tmp_path / f"part-{i}.libsvm"
+            write_libsvm(f, labels[sl], keys[sl], vals[sl])
+            files.append(str(f))
+        val = tmp_path / "val.libsvm"
+        write_libsvm(val, labels[2800:], keys[2800:], vals[2800:])
+
+        n_epochs = 6
+        cfg = {
+            "app": "linear_method",
+            "data": {
+                "files": files,
+                "format": "libsvm",
+                "num_keys": 1 << 15,
+                "val_files": [str(val)],
+                "max_nnz_per_example": 64,
+            },
+            "solver": {
+                "algo": "ftrl", "minibatch": 256, "max_delay": 1,
+                "epochs": n_epochs,
+            },
+            "lr": {"alpha": 0.3, "beta": 1.0},
+            "penalty": {"lambda_l1": 0.005},
+            "fault": {
+                "heartbeat_interval_s": 0.5,
+                "heartbeat_timeout_s": 5.0,  # dropped beats must not kill
+                "server_ckpt_interval_s": 0.5,
+                "server_restart_grace_s": 60.0,
+                "reconnect_timeout_s": 60.0,
+            },
+        }
+        app_file = tmp_path / "app.json"
+        app_file.write_text(json.dumps(cfg))
+
+        # deterministic cadences: 1/6 of frames dropped or delayed (>= 5%
+        # by construction), plus occasional lost replies and duplicates to
+        # drive the reply-cache dedup path
+        plan = (
+            "drop,every=12;delay,every=12,delay_s=0.01;"
+            "disconnect,every=31;duplicate,every=37"
+        )
+        out = launch_local(
+            str(app_file), num_servers=2, num_workers=2,
+            timeout=420, fault_kill="server:1@2.0",
+            fault_restart_after=0.5, ckpt_dir=str(tmp_path / "sckpt"),
+            fault_plan=plan, fault_seed=4242,
+        )
+        # completion through the outage: no worker declared dead, every
+        # (epoch, file) shard finished, and the attempts ledger balances —
+        # a resent workload_fetch that re-popped (double-applied) would
+        # break attempts == done + reassigned
+        assert out["dead_workers"] == [], out
+        wl = out["workloads"]
+        assert (wl["pending"], wl["active"], wl["done"]) == (0, 0, 4 * n_epochs), out
+        assert wl["attempts"] == wl["done"] + wl["reassigned"], out
+        # the plan genuinely engaged on the control plane: >= 5% of the
+        # coordinator's frames were perturbed (1/6 by cadence)
+        ch = out["chaos"]
+        frames = out["control_frames"]
+        assert frames > 100, out
+        assert ch["drop"] + ch["delay"] >= 0.05 * frames, out
+        # self-healing observability: clients retried/reconnected through
+        # the drops, and at least one lost reply or duplicated frame was
+        # answered from the reply cache instead of re-applied
+        merged = out["merged"]
+        assert merged["rpc_retries"] >= 1, merged
+        dedup_total = out["wire"].get("rpc_dedup_hits", 0) + sum(
+            st.get("rpc_dedup_hits", 0) for st in out["server_stats"]
+        )
+        assert dedup_total >= 1, out
+        # converged to the same final objective as the no-fault run of
+        # this family (>0.85), within the checkpoint-restart tolerance
         assert out["val_auc"] > 0.83, out
         assert out["nnz_w"] > 0

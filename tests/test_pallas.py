@@ -23,6 +23,11 @@ from parameter_server_tpu.ops.pallas_kernels import (
 def interpret_mode():
     from jax.experimental.pallas import tpu as pltpu
 
+    if not hasattr(pltpu, "force_tpu_interpret_mode"):
+        pytest.skip(
+            "this jax's pallas has no force_tpu_interpret_mode; "
+            "kernel parity is covered on real hardware by bench.py"
+        )
     with pltpu.force_tpu_interpret_mode():
         yield
 
