@@ -65,6 +65,12 @@ Sub-benches ("sub"):
                  quantized wire most.
   ingest       — host-side native parse MB/s + parse+localize ex/s per
                  stream (bounds e2e on co-located hardware).
+  wire_rpc     — loopback RPC tier microbench (ShardServer + ServerHandle
+                 over real TCP): pull/push round-trips/sec and p50/p99
+                 client-observed latency from the telemetry plane's
+                 log-bucketed histograms; its process telemetry snapshot
+                 is embedded in the full results as "telemetry", so
+                 BENCH_* rounds track RPC latency alongside throughput.
   last_tpu_capture — present only on a CPU fallback: names the newest
                  committed BENCH_r*_local.json real-hardware capture.
 """
@@ -105,12 +111,13 @@ CHILD_BUDGET_S = {
     "wd_push": 420,
     "darlin": 300,
     "ingest": 240,
+    "wire_rpc": 180,
 }
 # run order = value order: the contract fields land first, platform-bound
 # numbers next, platform-independent ones last
 CHILD_ORDER = (
     "headline", "pipeline_e2e", "hbm_scale", "ladder", "scale", "word2vec",
-    "matrix_fac", "darlin", "spmd_push", "wd_push", "ingest",
+    "matrix_fac", "darlin", "spmd_push", "wd_push", "ingest", "wire_rpc",
 )
 
 
@@ -1048,6 +1055,57 @@ def child_ingest() -> dict:
     return out
 
 
+def child_wire_rpc() -> dict:
+    """Loopback RPC tier microbench: a real ShardServer + ServerHandle
+    over TCP in one process — pull/push round-trips/sec plus the p50/p99
+    client-observed latencies the new telemetry plane records per
+    command. The process's merged telemetry snapshot rides along so the
+    full results file starts tracking RPC latency next to throughput."""
+    from parameter_server_tpu.kv.updaters import Ftrl
+    from parameter_server_tpu.parallel.multislice import ServerHandle, ShardServer
+    from parameter_server_tpu.utils.config import PSConfig
+    from parameter_server_tpu.utils.keyrange import KeyRange
+    from parameter_server_tpu.utils.metrics import (
+        hist_percentile,
+        latency_histograms,
+        telemetry_snapshot,
+    )
+
+    n_keys, iters = 1 << 18, 300
+    srv = ShardServer(
+        Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2),
+        KeyRange(0, n_keys),
+    ).start()
+    handle = ServerHandle(srv.address, 0, 0, PSConfig(), range_size=n_keys)
+    rng = np.random.default_rng(7)
+    keys = np.unique(rng.integers(1, n_keys, 1024)).astype(np.int64)
+    g = rng.normal(size=len(keys)).astype(np.float32)
+    for _ in range(20):  # warmup: jit the updater, settle TCP
+        handle.pull(keys)
+        handle.push(keys, g)
+    latency_histograms.reset()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        handle.pull(keys)
+        handle.push(keys, g)
+    dt = time.perf_counter() - t0
+    snap = latency_histograms.snapshot()
+    out: dict = {
+        "platform": "cpu-loopback",
+        "roundtrips_per_sec": round(2 * iters / dt, 1),
+        "touched_keys": int(len(keys)),
+    }
+    for cmd in ("pull", "push"):
+        s = snap.get(f"client.{cmd}")
+        if s:
+            out[f"{cmd}_p50_ms"] = round(hist_percentile(s, 0.5) * 1e3, 3)
+            out[f"{cmd}_p99_ms"] = round(hist_percentile(s, 0.99) * 1e3, 3)
+    handle.shutdown()
+    handle.close()
+    out["telemetry"] = telemetry_snapshot()
+    return out
+
+
 _CHILDREN = {
     "headline": child_headline,
     "pipeline_e2e": child_pipeline_e2e,
@@ -1060,6 +1118,7 @@ _CHILDREN = {
     "spmd_push": child_spmd_push,
     "wd_push": child_wd_push,
     "ingest": child_ingest,
+    "wire_rpc": child_wire_rpc,
 }
 
 
@@ -1187,12 +1246,17 @@ def main() -> None:
 
     results: dict = {}
     for name in CHILD_ORDER:
+        # wire_rpc measures host TCP + updater latency, never the
+        # accelerator: pin it to CPU like the cpu-sim meshes so a wedged
+        # tunnel can't take the telemetry block down with it
         child_env = (
-            _cpu_sim_env() if name in ("spmd_push", "wd_push") else env
+            _cpu_sim_env()
+            if name in ("spmd_push", "wd_push", "wire_rpc")
+            else env
         )
         r = _run_child(name, child_env, CHILD_BUDGET_S[name])
         results[name] = r
-        if "error" in r and name not in ("spmd_push", "wd_push") \
+        if "error" in r and name not in ("spmd_push", "wd_push", "wire_rpc") \
                 and not degraded:
             # the accelerator may have wedged mid-suite: re-probe, and run
             # everything that's left on the CPU fallback if it's gone
@@ -1234,7 +1298,16 @@ def main() -> None:
     top_platform = head.get("platform", platform)
     if degraded and "tpu" not in str(top_platform):
         top_platform = "cpu (fallback: accelerator unreachable)"
+    # the wire_rpc child carries its process's telemetry snapshot out; it
+    # rides the full results top-level so BENCH rounds track RPC latency
+    # histograms alongside throughput (popped: the sub entry stays scalar)
+    wire_rpc = results.get("wire_rpc", {})
+    telemetry = (
+        wire_rpc.pop("telemetry", None) if isinstance(wire_rpc, dict) else None
+    )
     extra = {}
+    if telemetry:
+        extra["telemetry"] = telemetry
     if "tpu" not in str(top_platform):
         cap = _newest_tpu_capture()
         if cap:
@@ -1261,6 +1334,7 @@ def main() -> None:
             "spmd_push": results.get("spmd_push", {}),
             "wd_push": results.get("wd_push", {}),
             "ingest": results.get("ingest", {}),
+            "wire_rpc": wire_rpc,
         },
         "suite_wall_s": round(time.perf_counter() - t_start, 1),
         **extra,
@@ -1336,6 +1410,11 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
                 "quantized_vs_per_worker"),
             "ingest": _pick(
                 "ingest", "parse_mb_per_sec", "parse_build_ex_per_sec"),
+            # the telemetry block: RPC latency reaches the driver-recorded
+            # line, not just the full results file
+            "rpc": _pick(
+                "wire_rpc", "roundtrips_per_sec", "pull_p50_ms",
+                "push_p99_ms"),
         },
     }
     if "last_tpu_capture" in full:

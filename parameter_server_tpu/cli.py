@@ -56,6 +56,12 @@ def _build_parser() -> argparse.ArgumentParser:
         help="process 0 hosts the pool Coordinator at --pool_coordinator "
         "itself (no external scheduler process needed)",
     )
+    tr.add_argument(
+        "--trace_dir", default="",
+        help="arm distributed tracing (utils/trace.py): spans exported as "
+        "Chrome trace-event JSON into this dir (open in Perfetto); "
+        "overrides config [trace] trace_dir and PS_TRACE_DIR",
+    )
 
     ev = sub.add_parser("evaluate", help="evaluate a dumped model")
     ev.add_argument("--app_file", required=True)
@@ -92,6 +98,11 @@ def _build_parser() -> argparse.ArgumentParser:
         "fault_plan",
     )
     nd.add_argument("--fault_seed", type=int, default=0)
+    nd.add_argument(
+        "--trace_dir", default="",
+        help="arm distributed tracing on this node (overrides config "
+        "[trace] trace_dir and PS_TRACE_DIR)",
+    )
 
     cv = sub.add_parser(
         "convert",
@@ -120,6 +131,23 @@ def _build_parser() -> argparse.ArgumentParser:
         "frame faults for recovery drills",
     )
     la.add_argument("--fault_seed", type=int, default=0)
+    la.add_argument(
+        "--trace_dir", default="",
+        help="arm distributed tracing on EVERY spawned node via "
+        "PS_TRACE_DIR: each process exports a Chrome trace-event JSON "
+        "into this dir; merge with utils/trace.py:merge_trace_dir and "
+        "open in Perfetto",
+    )
+
+    st = sub.add_parser(
+        "stats",
+        help="print the cluster telemetry table from a live coordinator "
+        "(the reference scheduler's dashboard): per-node counters + "
+        "merged per-command latency histograms (count/p50/p99)",
+    )
+    st.add_argument(
+        "--scheduler", required=True, help="coordinator host:port"
+    )
     return p
 
 
@@ -491,9 +519,55 @@ def run_evaluate(cfg: PSConfig, args: argparse.Namespace) -> dict:
     )
 
 
+def run_stats(args: argparse.Namespace) -> dict:
+    """The cluster dashboard (ref: the reference scheduler's printed
+    table): query a live coordinator's ``telemetry`` command and print
+    per-node rows + the merged per-command latency histograms."""
+    from parameter_server_tpu.parallel.control import ControlClient
+    from parameter_server_tpu.utils.metrics import (
+        format_cluster_stats,
+        hist_percentile,
+    )
+
+    ctl = ControlClient(args.scheduler, retries=5, reconnect_timeout_s=5.0)
+    try:
+        rep = ctl.telemetry()
+    finally:
+        ctl.close()
+    print(format_cluster_stats(rep))
+    merged = rep["merged"]
+    return {
+        "nodes": len(rep["nodes"]),
+        "counters": merged["counters"],
+        "latency_ms": {
+            name: {
+                "count": s.get("count", 0),
+                "p50": round(hist_percentile(s, 0.5) * 1e3, 3),
+                "p99": round(hist_percentile(s, 0.99) * 1e3, 3),
+            }
+            for name, s in merged["hists"].items()
+        },
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
+    if args.cmd == "stats":
+        # no config file: stats only needs a live coordinator address
+        print(json.dumps(run_stats(args), default=float))
+        return 0
     cfg = load_config(args.app_file)
+    if getattr(args, "trace_dir", ""):
+        # flag wins over both the config and the ambient env; run_node /
+        # PodTrainer re-arm with a role-specific process name from cfg
+        cfg.trace.trace_dir = args.trace_dir
+    if args.cmd == "train" and cfg.trace.trace_dir:
+        from parameter_server_tpu.utils import trace
+
+        trace.configure(
+            cfg.trace.trace_dir, capacity=cfg.trace.capacity,
+            process_name="train",
+        )
     if args.cmd == "train":
         out = run_train(cfg, args)
     elif args.cmd == "evaluate":
@@ -522,6 +596,7 @@ def main(argv: list[str] | None = None) -> int:
         out = launch_local(
             args.app_file, args.num_servers, args.num_workers, args.model_out,
             fault_plan=args.fault_plan, fault_seed=args.fault_seed,
+            trace_dir=args.trace_dir,
         )
     print(json.dumps(out, default=float))
     return 0

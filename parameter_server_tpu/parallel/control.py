@@ -53,8 +53,15 @@ import numpy as np
 from parameter_server_tpu.parallel.chaos import FaultPlan
 from parameter_server_tpu.parallel.ssp import SSPClock
 from parameter_server_tpu.parallel.workload import WorkloadPool
+from parameter_server_tpu.utils import trace
 from parameter_server_tpu.utils.heartbeat import HeartbeatMonitor
-from parameter_server_tpu.utils.metrics import merge_progress, wire_counters
+from parameter_server_tpu.utils.metrics import (
+    latency_histograms,
+    merge_progress,
+    merge_telemetry,
+    telemetry_snapshot,
+    wire_counters,
+)
 
 _LEN = struct.Struct("<II")
 
@@ -93,6 +100,11 @@ def send_frame(
     hb = json.dumps(h).encode()
     frame = _LEN.pack(len(hb), len(payload)) + hb + payload
     sock.sendall(frame)
+    # frame-layer byte accounting: EVERY framed message — coordinator and
+    # control traffic included — lands in the process-global counters, so
+    # the cluster's wire-byte columns no longer undercount to just the
+    # ServerHandle data plane
+    wire_counters.inc("wire_bytes_out", len(frame))
     return len(frame)
 
 
@@ -104,6 +116,7 @@ def recv_frame_sized(
     header = json.loads(_recv_exact(sock, hlen))
     payload = _recv_exact(sock, plen) if plen else b""
     nbytes = _LEN.size + hlen + plen
+    wire_counters.inc("wire_bytes_in", nbytes)  # frame layer (see send_frame)
     if header.get("zip"):
         payload = zlib.decompress(payload)
     arrays: Arrays = {}
@@ -238,18 +251,33 @@ class RpcServer:
                     time.sleep(fault.delay_s)
                 cid = header.pop("_cid", None)
                 seq = header.pop("_seq", None)
+                tctx = header.pop("_trace", None)  # caller's span identity
+                cmd_name = header.get("cmd", "?")
                 # copy BEFORE dispatch: handlers mutate the header (pop cmd)
                 dup_header = (
                     dict(header)
                     if fault is not None and fault.action == "duplicate"
                     else None
                 )
+                t_svc = time.perf_counter()
                 try:
-                    rep, rep_arrays = self._dispatch(cid, seq, header, arrays)
-                    if dup_header is not None:
-                        # the same frame delivered twice: without dedup this
-                        # double-applies (reply of the copy is discarded)
-                        self._dispatch(cid, seq, dup_header, arrays)
+                    # activate() binds the wire-borne trace context so the
+                    # dispatch span (and any handler spans under it) joins
+                    # the client's trace — one logical push is one trace id
+                    # across processes
+                    with trace.activate(tctx), trace.span(
+                        f"rpc.serve.{cmd_name}", cat="rpc", bytes_in=nbytes
+                    ):
+                        rep, rep_arrays = self._dispatch(
+                            cid, seq, header, arrays
+                        )
+                        if dup_header is not None:
+                            # the same frame delivered twice: without dedup
+                            # this double-applies (copy's reply discarded)
+                            self._dispatch(cid, seq, dup_header, arrays)
+                    latency_histograms.observe(
+                        f"server.{cmd_name}", time.perf_counter() - t_svc
+                    )
                 except RpcServer.Shutdown:
                     try:
                         send_frame(conn, {"ok": True})
@@ -441,7 +469,19 @@ class RpcClient:
                 _seq = self._next_seq
                 self._next_seq += 1
             header = {"cmd": cmd, "_cid": self._cid, "_seq": _seq, **fields}
-            rep, rep_arrays = self._call_locked(header, arrays, _retry)
+            t0 = time.perf_counter()
+            with trace.span(f"rpc.{cmd}", cat="rpc", addr=self._address):
+                # propagate this span's identity in the header so the
+                # server's dispatch span joins the same trace
+                ctx = trace.wire_context()
+                if ctx is not None:
+                    header["_trace"] = ctx
+                rep, rep_arrays = self._call_locked(header, arrays, _retry)
+            # client-observed latency: queueing + wire + service + any
+            # transparent retries/reconnects this call absorbed
+            latency_histograms.observe(
+                f"client.{cmd}", time.perf_counter() - t0
+            )
         if not rep.get("ok", True):
             raise RuntimeError(f"{cmd} failed remotely: {rep.get('error')}")
         return rep, rep_arrays
@@ -458,6 +498,9 @@ class RpcClient:
                 if self._sock is None:
                     self._sock = self._connect()
                     wire_counters.inc("rpc_reconnects")
+                    trace.instant(
+                        "rpc.reconnect", cat="rpc", addr=self._address
+                    )
                 self.bytes_out += send_frame(self._sock, header, arrays)
                 rep, rep_arrays, nbytes = recv_frame_sized(self._sock)
                 self.bytes_in += nbytes
@@ -467,6 +510,10 @@ class RpcClient:
                 if self._closed or not retry or time.monotonic() >= deadline:
                     raise
                 wire_counters.inc("rpc_retries")
+                trace.instant(
+                    "rpc.retry", cat="rpc", addr=self._address,
+                    attempt=attempt,
+                )
                 # exponential backoff + jitter: a server resetting every
                 # connect must not be hammered at full speed, and lockstep
                 # clients must not reconnect in synchronized waves
@@ -542,6 +589,7 @@ class Coordinator:
             idempotent_cmds=frozenset({
                 "kv_get", "kv_set", "nodes", "beat", "progress",
                 "progress_merged", "workload_stats", "ssp_progress",
+                "telemetry",
             }),
         )
         self.server.start()
@@ -715,6 +763,35 @@ class Coordinator:
         self._monitor.beat(int(h["node_id"]), h.get("stats"))
         return {"ok": True}, {}
 
+    def _cmd_telemetry(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        """Cluster telemetry (ref: the scheduler's dashboard, reborn):
+        every node's last heartbeat piggybacked a counters+histograms
+        snapshot; this merges them — plus the coordinator's own process
+        — into one cluster view, and returns the per-node detail."""
+        with self._cv:
+            registry = {int(k): dict(v) for k, v in self._nodes.items()}
+        per_node: dict[str, dict[str, Any]] = {}
+        node_snaps: list[dict[str, Any]] = []
+        for nid, stats in self._monitor.latest_stats().items():
+            stats = dict(stats)
+            tel = stats.pop("telemetry", None)
+            info = registry.get(nid, {})
+            per_node[str(nid)] = {
+                "role": info.get("role", "?"),
+                "rank": info.get("rank"),
+                "stats": stats,
+                "telemetry": tel,
+            }
+            if tel:
+                node_snaps.append(tel)
+        local = telemetry_snapshot()  # the coordinator's own process
+        return {
+            "ok": True,
+            "nodes": per_node,
+            "coordinator": local,
+            "merged": merge_telemetry(node_snaps + [local]),
+        }, {}
+
     def _cmd_dead(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
         return {"ok": True, "dead": self._monitor.dead(), "alive": self._monitor.alive()}, {}
 
@@ -833,6 +910,12 @@ class ControlClient(RpcClient):
 
     def beat(self, node_id: int, stats: dict | None = None) -> None:
         self.call("beat", node_id=node_id, stats=stats)
+
+    def telemetry(self) -> dict[str, Any]:
+        """Cluster telemetry: per-node snapshots + the merged view
+        (counters summed, latency histograms merged bucket-wise)."""
+        rep, _ = self.call("telemetry")
+        return {k: rep[k] for k in ("nodes", "coordinator", "merged")}
 
     def ssp_init(self, num_workers: int, max_delay: int) -> None:
         self.call("ssp_init", num_workers=num_workers, max_delay=max_delay)

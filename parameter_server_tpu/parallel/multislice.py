@@ -44,10 +44,20 @@ from parameter_server_tpu.parallel.control import (
     RpcClient,
     RpcServer,
 )
+from parameter_server_tpu.utils import trace
 from parameter_server_tpu.utils.config import PSConfig
-from parameter_server_tpu.utils.heartbeat import HeartbeatReporter
+from parameter_server_tpu.utils.heartbeat import HeartbeatReporter, host_stats
 from parameter_server_tpu.utils.keyrange import KeyRange
-from parameter_server_tpu.utils.metrics import wire_counters
+from parameter_server_tpu.utils.metrics import telemetry_snapshot, wire_counters
+
+
+def _with_trace_ctx(ctx, fn, *args):
+    """Run ``fn`` on a pool thread under a captured trace context: thread
+    locals don't cross ThreadPoolExecutor, so the step span's identity
+    must be carried over explicitly or the per-server ps.pull/ps.push
+    spans would each start an unrelated trace."""
+    with trace.activate(ctx):
+        return fn(*args)
 
 
 def _plan_from_cfg(cfg: PSConfig) -> FaultPlan | None:
@@ -215,24 +225,30 @@ class ShardServer:
         an in-flight periodic dump on the shared tmp file)."""
         import os
 
-        with self._lock:
-            host = {k: np.asarray(v) for k, v in self.state.items()}
-            # same critical section as the state snapshot: the ledger in a
-            # checkpoint must witness exactly the pushes that checkpoint
-            # contains — never one more, never one fewer
-            ledger = json.dumps(
-                {cid: list(per) for cid, per in self._applied_push.items()}
-            )
-        with self._ckpt_write_lock:
-            os.makedirs(ckpt_dir, exist_ok=True)
-            path = self._ckpt_path(ckpt_dir)
-            tmp = path + ".tmp.npz"  # .npz suffix: savez must not append one
-            np.savez(
-                tmp,
-                __push_ledger__=np.frombuffer(ledger.encode(), dtype=np.uint8),
-                **host,
-            )
-            os.replace(tmp, path)
+        with trace.span(
+            "server.checkpoint.save", cat="ckpt",
+            range=f"{self.range.begin}-{self.range.end}",
+        ):
+            with self._lock:
+                host = {k: np.asarray(v) for k, v in self.state.items()}
+                # same critical section as the state snapshot: the ledger
+                # in a checkpoint must witness exactly the pushes that
+                # checkpoint contains — never one more, never one fewer
+                ledger = json.dumps(
+                    {cid: list(per) for cid, per in self._applied_push.items()}
+                )
+            with self._ckpt_write_lock:
+                os.makedirs(ckpt_dir, exist_ok=True)
+                path = self._ckpt_path(ckpt_dir)
+                tmp = path + ".tmp.npz"  # .npz: savez must not append one
+                np.savez(
+                    tmp,
+                    __push_ledger__=np.frombuffer(
+                        ledger.encode(), dtype=np.uint8
+                    ),
+                    **host,
+                )
+                os.replace(tmp, path)
 
     def load_state(self, ckpt_dir: str) -> bool:
         """Load this range's dump if one exists; False when absent."""
@@ -241,7 +257,9 @@ class ShardServer:
         path = self._ckpt_path(ckpt_dir)
         if not os.path.exists(path):
             return False
-        with np.load(path) as z:
+        with trace.span("server.checkpoint.load", cat="ckpt"), np.load(
+            path
+        ) as z:
             host = {k: z[k] for k in z.files}
         ledger_raw = host.pop("__push_ledger__", None)
         if set(host) != set(self.state) or any(
@@ -326,14 +344,20 @@ class ShardServer:
                 # pin this bounce, so the keyed follow-up (same seq) re-runs
                 return {"ok": True, "need_keys": True, "_transient": True}, {}
             g = self._decode_grad(h, arrays).reshape(len(keys), -1)
-            with self._lock:
-                rows = {k: v[keys] for k, v in self.state.items()}
-                deltas = self.updater.delta(rows, self._jnp.asarray(g))
-                self.state = {
-                    k: self.state[k].at[keys].add(deltas[k]) for k in self.state
-                }
-                if cid is not None:
-                    self._record_push(cid, seq)
+            # updater span: the server-side cost of applying this push
+            # (child of the rpc.serve.push dispatch span, which already
+            # carries the client's trace id — the third hop of the
+            # client -> dispatch -> updater chain)
+            with trace.span("server.updater", cat="ps", keys=len(keys)):
+                with self._lock:
+                    rows = {k: v[keys] for k, v in self.state.items()}
+                    deltas = self.updater.delta(rows, self._jnp.asarray(g))
+                    self.state = {
+                        k: self.state[k].at[keys].add(deltas[k])
+                        for k in self.state
+                    }
+                    if cid is not None:
+                        self._record_push(cid, seq)
             self._bump("pushes")
             return {"ok": True}, {}
         if cmd == "dump":
@@ -542,7 +566,11 @@ class ServerHandle:
     def pull(self, local_keys: np.ndarray) -> np.ndarray:
         if len(local_keys) == 0:
             return np.zeros(0, dtype=np.float32)
-        _, out = self._keyed_call("pull", local_keys, {})
+        with trace.span(
+            "ps.pull", cat="ps", rank=self.rank, keys=len(local_keys)
+        ) as sp:
+            _, out = self._keyed_call("pull", local_keys, {})
+            sp.set(bytes=int(out["w"].nbytes))
         return out["w"].astype(np.float32)
 
     def push(self, local_keys: np.ndarray, grads: np.ndarray) -> None:
@@ -565,7 +593,11 @@ class ServerHandle:
             fields["codec"] = self._codec_bytes
         else:
             arrays = {"g": grads.astype(np.float32)}
-        self._keyed_call("push", local_keys, arrays, **fields)
+        with trace.span(
+            "ps.push", cat="ps", rank=self.rank, keys=len(local_keys),
+            bytes=int(sum(a.nbytes for a in arrays.values())),
+        ):
+            self._keyed_call("push", local_keys, arrays, **fields)
 
     def dump(self) -> tuple[int, np.ndarray]:
         rep, out = self.client.call("dump")
@@ -628,11 +660,20 @@ class _RemoteBeatSink:
 class _Beats:
     """A node's liveness heartbeat: HeartbeatReporter over a dedicated
     coordinator connection (ref: the reference's heartbeat thread —
-    liveness must not depend on training cadence)."""
+    liveness must not depend on training cadence). Each beat piggybacks
+    this process's telemetry snapshot (counters + latency histograms +
+    named timers), which is what the coordinator's ``telemetry`` command
+    merges into the cluster view — no second collection path."""
 
     def __init__(self, scheduler: str, node_id: int, interval_s: float):
         self._sink = _RemoteBeatSink(scheduler)
-        self._rep = HeartbeatReporter(self._sink, node_id, interval_s)
+
+        def beat_stats() -> dict:
+            return {**host_stats(), "telemetry": telemetry_snapshot()}
+
+        self._rep = HeartbeatReporter(
+            self._sink, node_id, interval_s, stats_fn=beat_stats
+        )
         self._rep.start()
 
     def stop(self) -> None:
@@ -687,6 +728,7 @@ def run_server(
         srv.save_state(ckpt_dir)
     beats.stop()
     ctl.close()
+    trace.tracer.flush()  # export this process's spans (no-op if disabled)
 
 
 def _connect_servers(
@@ -790,11 +832,11 @@ def run_worker(
                 "ex_per_sec": n / max(time.perf_counter() - t0, 1e-9),
                 # MEASURED wire traffic, cumulative for this worker (ref:
                 # the Postoffice per-message byte counters) — merged at the
-                # scheduler as a sum over workers
-                "wire_bytes_out": sum(sh.client.bytes_out for sh in servers)
-                + ctl.bytes_out,
-                "wire_bytes_in": sum(sh.client.bytes_in for sh in servers)
-                + ctl.bytes_in,
+                # scheduler as a sum over workers. Counted at the FRAME
+                # layer (send_frame/recv_frame), so control, heartbeat and
+                # data-plane traffic are all in
+                "wire_bytes_out": wire_counters.get("wire_bytes_out"),
+                "wire_bytes_in": wire_counters.get("wire_bytes_in"),
                 # self-healing counters, cumulative for this worker process
                 # (merged at the scheduler as cluster totals)
                 "rpc_retries": wire_counters.get("rpc_retries"),
@@ -805,7 +847,8 @@ def run_worker(
         t0 = time.perf_counter()
 
     while True:
-        workload = ctl.workload_fetch(rank)
+        with trace.span("step.workload_fetch", cat="step"):
+            workload = ctl.workload_fetch(rank)
         if workload is None:
             if ctl.workload_all_done():
                 break
@@ -821,28 +864,52 @@ def run_worker(
             # step t includes this worker's finished counter (wait_time
             # semantics), so draining after the gate would self-deadlock
             drain(inflight_limit)
-            ctl.ssp_wait(rank, step)
-            # slice the batch's (sorted) unique keys against server ranges
-            real = b.unique_keys[1 : b.num_unique]
-            bounds = np.searchsorted(real, begins)
-            # range-relative int64; the handle picks the wire dtype
-            segs = [
-                real[bounds[s] : bounds[s + 1]] - ranges[s].begin
-                for s in range(num_servers)
-            ]
-            pulls = list(
-                pool.map(lambda sh_seg: sh_seg[0].pull(sh_seg[1]), zip(servers, segs))
-            )
-            w_u = np.zeros(len(b.unique_keys), dtype=np.float32)
-            w_u[1 : b.num_unique] = np.concatenate(pulls) if pulls else []
-            loss, probs, g = grad_step(
-                w_u, b.values, b.local_ids, b.row_ids, b.labels, b.example_mask
-            )
-            g_real = np.asarray(g).ravel()[1 : b.num_unique]
-            futs = [
-                pool.submit(servers[s].push, segs[s], g_real[bounds[s] : bounds[s + 1]])
-                for s in range(num_servers)
-            ]
+            # step anatomy (the "where did this step's 40 ms go" spans):
+            # one enclosing step span; ssp_wait / pull / compute are its
+            # children, and its context is carried onto the pool threads
+            # so the per-server ps.pull / in-flight ps.push RPC chains
+            # join the SAME trace instead of starting their own
+            with trace.span("step", cat="step", step=step):
+                step_ctx = trace.wire_context()
+                with trace.span("step.ssp_wait", cat="step"):
+                    ctl.ssp_wait(rank, step)
+                # slice the batch's (sorted) unique keys against ranges
+                real = b.unique_keys[1 : b.num_unique]
+                bounds = np.searchsorted(real, begins)
+                # range-relative int64; the handle picks the wire dtype
+                segs = [
+                    real[bounds[s] : bounds[s + 1]] - ranges[s].begin
+                    for s in range(num_servers)
+                ]
+                with trace.span("step.pull", cat="step"):
+                    pull_ctx = trace.wire_context()
+                    pulls = list(
+                        pool.map(
+                            lambda sh_seg: _with_trace_ctx(
+                                pull_ctx, sh_seg[0].pull, sh_seg[1]
+                            ),
+                            zip(servers, segs),
+                        )
+                    )
+                with trace.span("step.compute", cat="step"):
+                    w_u = np.zeros(len(b.unique_keys), dtype=np.float32)
+                    w_u[1 : b.num_unique] = (
+                        np.concatenate(pulls) if pulls else []
+                    )
+                    loss, probs, g = grad_step(
+                        w_u, b.values, b.local_ids, b.row_ids, b.labels,
+                        b.example_mask,
+                    )
+                    g_real = np.asarray(g).ravel()[1 : b.num_unique]
+                # pushes ride the thread pool past this span's exit; the
+                # captured step context still parents their ps.push chains
+                futs = [
+                    pool.submit(
+                        _with_trace_ctx, step_ctx, servers[s].push,
+                        segs[s], g_real[bounds[s] : bounds[s + 1]],
+                    )
+                    for s in range(num_servers)
+                ]
             pending.append((step, futs))
             ex_seen += b.num_examples
             window.append(
@@ -867,6 +934,7 @@ def run_worker(
     for sh in servers:
         sh.close()
     ctl.close()
+    trace.tracer.flush()  # export this process's spans (no-op if disabled)
 
 
 def run_scheduler(
@@ -1003,6 +1071,10 @@ def run_scheduler(
         # in-process, so rpc_dedup_hits here covers every control frame
         # the cluster resent or duplicated
         "wire": wire_counters.snapshot(),
+        # cluster telemetry merged from every node's heartbeat snapshot
+        # (+ this process): counters, per-command latency histograms,
+        # named timers — the `cli stats` view, embedded in the run result
+        "telemetry": ctl.telemetry()["merged"],
     }
     chaos_stats = coordinator.server.fault_stats()
     if chaos_stats is not None:
@@ -1028,6 +1100,7 @@ def run_scheduler(
         sh.close()
     ctl.close()
     coordinator.stop()
+    trace.tracer.flush()  # export this process's spans (no-op if disabled)
     return out
 
 
@@ -1043,6 +1116,7 @@ def launch_local(
     ckpt_dir: str = "",
     fault_plan: str = "",
     fault_seed: int = 0,
+    trace_dir: str = "",
 ) -> dict[str, Any]:
     """Spawn scheduler + servers + workers as real processes on this host
     (ref: script/local.sh — the de-facto integration test harness).
@@ -1086,6 +1160,11 @@ def launch_local(
         FaultPlan.parse(fault_plan, seed=fault_seed)  # fail fast on a typo
         child_env[PLAN_ENV] = fault_plan
         child_env[SEED_ENV] = str(fault_seed)
+    if trace_dir:
+        # arm tracing on EVERY spawned node (the PS_FAULT_PLAN pattern):
+        # each process exports trace-<role>-<rank>-<pid>.json into this dir
+        os.makedirs(trace_dir, exist_ok=True)
+        child_env[trace.TRACE_DIR_ENV] = trace_dir
 
     import tempfile
 
@@ -1227,6 +1306,17 @@ def run_node(
     ckpt_dir: str = "",
 ) -> dict[str, Any] | None:
     """Role dispatch for one spawned process (ref: App::Create + main.cc)."""
+    import os
+
+    # arm tracing for this node: config [trace] trace_dir wins, then the
+    # inherited PS_TRACE_DIR env (launch_local's arming path); the process
+    # name makes each node's export file self-describing
+    tdir = cfg.trace.trace_dir or os.environ.get(trace.TRACE_DIR_ENV, "")
+    if tdir:
+        trace.configure(
+            tdir, capacity=cfg.trace.capacity,
+            process_name=f"{role}-{rank}",
+        )
     if role == "scheduler":
         host, port = scheduler.rsplit(":", 1)
         coord = Coordinator(

@@ -16,6 +16,7 @@ the clock gives the bound."""
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from collections.abc import Callable
 from typing import Any
@@ -78,6 +79,10 @@ class SSPClock:
         self.num_workers = num_workers
         self.max_delay = max_delay
         self._finished = [-1] * num_workers  # highest finished step per worker
+        # per-worker blocked-time accounting (telemetry: "where did this
+        # step's 40 ms go" — the SSP gate is one of the places)
+        self._blocked_s = [0.0] * num_workers
+        self._blocked_n = [0] * num_workers
         self._cv = threading.Condition()
 
     def _min_finished(self) -> int:
@@ -101,9 +106,15 @@ class SSPClock:
             return True
         target = step - self.max_delay - 1
         with self._cv:
-            return self._cv.wait_for(
+            if self._min_finished() >= target:
+                return True  # gate already open: no blocked time to book
+            t0 = time.perf_counter()
+            ok = self._cv.wait_for(
                 lambda: self._min_finished() >= target, timeout=timeout
             )
+            self._blocked_s[worker] += time.perf_counter() - t0
+            self._blocked_n[worker] += 1
+            return ok
 
     def finish(self, worker: int, step: int) -> None:
         with self._cv:
@@ -135,6 +146,10 @@ class SSPClock:
                 "retired": [
                     w for w, f in enumerate(self._finished) if f >= self.RETIRED
                 ],
+                # cumulative seconds (and waits) each worker spent parked
+                # on the gate — the per-worker SSP-wait telemetry
+                "blocked_s": [round(s, 6) for s in self._blocked_s],
+                "blocked_n": list(self._blocked_n),
             }
 
     def state_dict(self) -> dict:
@@ -145,4 +160,8 @@ class SSPClock:
         with self._cv:
             self._finished = list(d["finished"])
             self.max_delay = d["max_delay"]
+            # blocked-time telemetry is per-process, not model state:
+            # restart it with the restored worker count
+            self._blocked_s = [0.0] * len(self._finished)
+            self._blocked_n = [0] * len(self._finished)
             self._cv.notify_all()

@@ -42,8 +42,9 @@ from parameter_server_tpu.parallel.spmd import (
 )
 from parameter_server_tpu.parallel.ssp import DispatchWindow, SSPClock
 from parameter_server_tpu.parallel.workload import WorkloadPool
+from parameter_server_tpu.utils import trace
 from parameter_server_tpu.utils.config import PSConfig
-from parameter_server_tpu.utils.metrics import ProgressReporter
+from parameter_server_tpu.utils.metrics import ProgressReporter, timers
 
 
 # process-wide trainer sequence for control-plane KV namespacing (see
@@ -144,6 +145,13 @@ class PodTrainer:
         profile_dir: str = "",
     ):
         self.cfg = cfg
+        if cfg.trace.trace_dir and not trace.tracer.enabled:
+            # config-armed tracing for the in-process pod path (spawned
+            # nodes arm via run_node / PS_TRACE_DIR instead)
+            trace.configure(
+                cfg.trace.trace_dir, capacity=cfg.trace.capacity,
+                process_name="pod-trainer",
+            )
         if runtime is not None:
             self.runtime = runtime
         else:
@@ -489,8 +497,10 @@ class PodTrainer:
             # np.asarray blocks until the device call is done (the SSP
             # bound taking effect); single-step outputs are scalars,
             # multistep outputs carry a (K,) microstep axis
-            losses = np.atleast_1d(np.asarray(loss_arr))
-            exs = np.atleast_1d(np.asarray(examples_arr))
+            with trace.span("step.retire", cat="step", step=step), \
+                    timers.timer("trainer.retire"):
+                losses = np.atleast_1d(np.asarray(loss_arr))
+                exs = np.atleast_1d(np.asarray(examples_arr))
             self.clock.finish(0, step)
             # empties only ever trail real batches within a group, so the
             # LAST microstep's pod-wide count is the drained signal
@@ -579,21 +589,30 @@ class PodTrainer:
                 gate.gate(step_idx)
                 if drained:
                     break
-                if K == 1:
-                    stacked_np, n, labels, mask_counts = _next_item()
-                    metas = [(labels, mask_counts)]
-                else:
-                    stacked_np, n, metas = _next_item()
-                if self._bucket_sync:
-                    stacked_np = self._agree_bucket(
-                        stacked_np, f"{bkt_gen}/{step_idx}"
+                # step anatomy: fetch (host pipeline pop) vs dispatch
+                # (bucket agreement + H2D + device-call issue) — named
+                # timers feed the telemetry snapshot, spans the timeline
+                with trace.span("step.fetch", cat="step", step=step_idx), \
+                        timers.timer("trainer.fetch"):
+                    if K == 1:
+                        stacked_np, n, labels, mask_counts = _next_item()
+                        metas = [(labels, mask_counts)]
+                    else:
+                        stacked_np, n, metas = _next_item()
+                with trace.span("step.dispatch", cat="step", step=step_idx), \
+                        timers.timer("trainer.dispatch"):
+                    if self._bucket_sync:
+                        stacked_np = self._agree_bucket(
+                            stacked_np, f"{bkt_gen}/{step_idx}"
+                        )
+                    stacked = self.runtime.globalize_batch(stacked_np)
+                    # push_seed varies per microstep so quantized-push
+                    # stochastic rounding never reuses a key (traced
+                    # scalar: no recompile); step_idx * K is this call's
+                    # first microstep index
+                    self.state, out = self.step_fn(
+                        self.state, stacked, step_idx * K
                     )
-                stacked = self.runtime.globalize_batch(stacked_np)
-                # push_seed varies per microstep so quantized-push
-                # stochastic rounding never reuses a key (traced scalar:
-                # no recompile); step_idx * K is this call's first
-                # microstep index
-                self.state, out = self.step_fn(self.state, stacked, step_idx * K)
                 self.examples_seen += n
                 n_since += n
                 gate.add(

@@ -217,6 +217,18 @@ class FaultConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Distributed tracing (utils/trace.py). ``trace_dir`` arms span
+    capture + Chrome trace-event export (open in Perfetto) on every
+    process this config reaches; the ``PS_TRACE_DIR`` env var arms
+    processes the config never touches (spawned children — the
+    PS_FAULT_PLAN inheritance pattern)."""
+
+    trace_dir: str = ""  # "" = tracing disabled (the free no-op path)
+    capacity: int = 65536  # span ring-buffer bound per process
+
+
+@dataclass
 class PSConfig:
     """Top-level app config (ref: linear_method.proto LinearMethodConfig)."""
 
@@ -233,6 +245,7 @@ class PSConfig:
     wd: WDConfig = field(default_factory=WDConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
     model_output: str = ""
     report_interval: int = 1  # progress print cadence, in reports (ref gflag)
     seed: int = 0
@@ -274,6 +287,7 @@ _NESTED = {
     "wd": WDConfig,
     "parallel": ParallelConfig,
     "fault": FaultConfig,
+    "trace": TraceConfig,
 }
 
 

@@ -67,6 +67,12 @@ class HeartbeatMonitor:
                 n for n, b in self._beats.items() if now - b["t"] > self.timeout_s
             )
 
+    def latest_stats(self) -> dict[int, dict]:
+        """Last-reported stats per node (the telemetry plane's raw feed:
+        nodes piggyback counter/histogram snapshots on their beats)."""
+        with self._lock:
+            return {n: dict(b["stats"]) for n, b in self._beats.items()}
+
     def forget(self, node_id: int) -> None:
         """Drop a node's record once its death has been *handled* (workloads
         requeued, clock retired) or it finished cleanly: ``dead()`` stays
@@ -93,26 +99,36 @@ class HeartbeatMonitor:
 
 
 class HeartbeatReporter:
-    """Per-node thread beating into a monitor every ``interval_s``."""
+    """Per-node thread beating into a monitor every ``interval_s``.
+
+    ``stats_fn`` builds each beat's stats payload (default: host_stats);
+    the multi-process tier passes a function that piggybacks the node's
+    telemetry snapshot so the scheduler's cluster view needs no second
+    collection path (ref: heartbeat_info carrying the dashboard stats)."""
 
     def __init__(
-        self, monitor: HeartbeatMonitor, node_id: int, interval_s: float = 5.0
+        self,
+        monitor: HeartbeatMonitor,
+        node_id: int,
+        interval_s: float = 5.0,
+        stats_fn=host_stats,
     ):
         self.monitor = monitor
         self.node_id = node_id
         self.interval_s = interval_s
+        self._stats_fn = stats_fn
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def start(self) -> "HeartbeatReporter":
-        self.monitor.beat(self.node_id, host_stats())  # immediate first beat
+        self.monitor.beat(self.node_id, self._stats_fn())  # immediate first beat
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         return self
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
-            self.monitor.beat(self.node_id, host_stats())
+            self.monitor.beat(self.node_id, self._stats_fn())
 
     def stop(self) -> None:
         self._stop.set()

@@ -55,24 +55,144 @@ class CounterSet:
 wire_counters = CounterSet()
 
 
-class Timer:
-    """tic/toc accumulator (ref: util/resource_usage.h)."""
+#: log2 latency buckets: bucket i covers [2^(i-1), 2^i) microseconds
+#: (bucket 0 is < 1 us); 40 buckets reach ~9 days — nothing clips
+_HIST_BUCKETS = 40
+
+
+class Histogram:
+    """Thread-safe log2-bucketed latency histogram (ref: the scheduler's
+    per-link latency accounting the comm-optimization papers require).
+
+    Observations are seconds; buckets are powers of two of microseconds,
+    so the whole distribution is ~40 ints — cheap to snapshot into a
+    heartbeat and exact to merge across nodes (bucket-wise sums)."""
+
+    __slots__ = ("_counts", "_count", "_sum", "_lock")
 
     def __init__(self) -> None:
-        self._t0: float | None = None
+        self._counts = [0] * _HIST_BUCKETS
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        i = int(seconds * 1e6).bit_length()
+        if i >= _HIST_BUCKETS:
+            i = _HIST_BUCKETS - 1
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += seconds
+
+    def snapshot(self) -> dict[str, Any]:
+        """Wire-friendly form: sparse ``{bucket_index: count}`` (JSON
+        string keys) plus count/sum — what heartbeats piggyback."""
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum_s": self._sum,
+                "buckets": {
+                    str(i): c for i, c in enumerate(self._counts) if c
+                },
+            }
+
+    def percentile(self, p: float) -> float:
+        return hist_percentile(self.snapshot(), p)
+
+
+def hist_percentile(snap: dict[str, Any], p: float) -> float:
+    """p-quantile (0..1) in SECONDS from a Histogram snapshot: the upper
+    edge of the bucket holding the p-th observation (log2 resolution —
+    good enough for p50/p99 dashboards, exact under merging)."""
+    total = snap.get("count", 0)
+    if not total:
+        return 0.0
+    target = max(1, int(p * total + 0.9999999))
+    cum = 0
+    for i in sorted(int(k) for k in snap.get("buckets", {})):
+        cum += snap["buckets"][str(i)]
+        if cum >= target:
+            return (1 << i) / 1e6  # bucket i upper edge in us
+    return (1 << (_HIST_BUCKETS - 1)) / 1e6
+
+
+def merge_hist_snapshots(snaps: list[dict[str, Any]]) -> dict[str, Any]:
+    """Bucket-wise sum of Histogram snapshots (the cluster-wide merge)."""
+    out: dict[str, Any] = {"count": 0, "sum_s": 0.0, "buckets": {}}
+    for s in snaps:
+        out["count"] += s.get("count", 0)
+        out["sum_s"] += s.get("sum_s", 0.0)
+        for k, c in s.get("buckets", {}).items():
+            out["buckets"][k] = out["buckets"].get(k, 0) + c
+    return out
+
+
+class HistogramSet:
+    """Named histograms (thread-safe, created on first observe). One
+    process-global instance, ``latency_histograms``, holds per-command
+    RPC latencies: ``client.<cmd>`` (client-observed, includes retries)
+    and ``server.<cmd>`` (server dispatch/service time)."""
+
+    def __init__(self) -> None:
+        self._d: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, name: str, seconds: float) -> None:
+        h = self._d.get(name)
+        if h is None:
+            with self._lock:
+                h = self._d.setdefault(name, Histogram())
+        h.observe(seconds)
+
+    def get(self, name: str) -> Histogram | None:
+        with self._lock:
+            return self._d.get(name)
+
+    def snapshot(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            hists = dict(self._d)
+        return {k: h.snapshot() for k, h in hists.items()}
+
+    def reset(self) -> None:
+        """Tests/benchmarks only (see CounterSet.reset)."""
+        with self._lock:
+            self._d.clear()
+
+
+#: process-global per-command RPC latency histograms
+latency_histograms = HistogramSet()
+
+
+class Timer:
+    """tic/toc accumulator (ref: util/resource_usage.h).
+
+    Thread-safe: the live ``t0`` is thread-local (the checkpoint thread
+    and serve threads tic/toc concurrently without racing each other's
+    start marks) and the totals are lock-protected."""
+
+    def __init__(self) -> None:
+        self._local = threading.local()
+        self._lock = threading.Lock()
         self.total = 0.0
         self.count = 0
 
     def tic(self) -> None:
-        self._t0 = time.perf_counter()
+        self._local.t0 = time.perf_counter()
 
     def toc(self) -> float:
-        assert self._t0 is not None, "toc without tic"
-        dt = time.perf_counter() - self._t0
-        self.total += dt
-        self.count += 1
-        self._t0 = None
+        t0 = getattr(self._local, "t0", None)
+        assert t0 is not None, "toc without tic"
+        dt = time.perf_counter() - t0
+        self._local.t0 = None
+        with self._lock:
+            self.total += dt
+            self.count += 1
         return dt
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return {"total_s": self.total, "count": self.count}
 
     def __enter__(self) -> "Timer":
         self.tic()
@@ -80,6 +200,120 @@ class Timer:
 
     def __exit__(self, *exc: Any) -> None:
         self.toc()
+
+
+class TimerRegistry:
+    """Process-global named timers (ref: resource_usage.h's named tic/toc
+    tables): ``timers.timer("trainer.dispatch")`` returns one shared
+    Timer per name, and ``snapshot()`` rides the telemetry plane."""
+
+    def __init__(self) -> None:
+        self._d: dict[str, Timer] = {}
+        self._lock = threading.Lock()
+
+    def timer(self, name: str) -> Timer:
+        t = self._d.get(name)
+        if t is None:
+            with self._lock:
+                t = self._d.setdefault(name, Timer())
+        return t
+
+    def snapshot(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            ts = dict(self._d)
+        return {k: t.snapshot() for k, t in ts.items()}
+
+    def reset(self) -> None:
+        """Tests/benchmarks only."""
+        with self._lock:
+            self._d.clear()
+
+
+#: process-global named-timer registry (included in telemetry snapshots)
+timers = TimerRegistry()
+
+
+def telemetry_snapshot() -> dict[str, Any]:
+    """This process's full telemetry state — counters, per-command
+    latency histograms, named timers. Small (sparse dicts), so nodes
+    piggyback it on every heartbeat and the coordinator merges the
+    cluster view without a second collection path."""
+    return {
+        "counters": wire_counters.snapshot(),
+        "hists": latency_histograms.snapshot(),
+        "timers": timers.snapshot(),
+    }
+
+
+def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
+    """Cluster merge of telemetry snapshots: counters and timers sum,
+    histograms merge bucket-wise (exact — no quantile averaging)."""
+    counters: dict[str, int] = {}
+    hists: dict[str, list[dict]] = {}
+    tmr: dict[str, dict[str, float]] = {}
+    for s in snaps:
+        for k, v in s.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for k, v in s.get("hists", {}).items():
+            hists.setdefault(k, []).append(v)
+        for k, v in s.get("timers", {}).items():
+            t = tmr.setdefault(k, {"total_s": 0.0, "count": 0})
+            t["total_s"] += v.get("total_s", 0.0)
+            t["count"] += v.get("count", 0)
+    return {
+        "counters": counters,
+        "hists": {k: merge_hist_snapshots(v) for k, v in hists.items()},
+        "timers": tmr,
+    }
+
+
+def format_latency_table(hists: dict[str, dict[str, Any]]) -> str:
+    """Per-command latency table (count / mean / p50 / p99 in ms) from a
+    ``hists`` snapshot — the core of the ``cli stats`` dashboard."""
+    lines = [f"{'command':<28} {'count':>9} {'mean_ms':>9} {'p50_ms':>9} {'p99_ms':>9}"]
+    for name in sorted(hists):
+        s = hists[name]
+        n = s.get("count", 0)
+        mean = (s.get("sum_s", 0.0) / n * 1e3) if n else 0.0
+        lines.append(
+            f"{name:<28} {n:>9} {mean:>9.3f} "
+            f"{hist_percentile(s, 0.5) * 1e3:>9.3f} "
+            f"{hist_percentile(s, 0.99) * 1e3:>9.3f}"
+        )
+    return "\n".join(lines)
+
+
+def format_cluster_stats(rep: dict[str, Any]) -> str:
+    """The cluster telemetry dump (ref: the reference scheduler's live
+    dashboard table): one row per node (liveness stats + headline
+    counters), then the merged per-command latency table."""
+    lines = [
+        f"{'node':>5} {'role':<10} {'rank':>5} {'rss_mb':>8} "
+        f"{'wire_out':>12} {'wire_in':>12} {'retries':>8} {'dedup':>6}"
+    ]
+    for nid in sorted(rep.get("nodes", {}), key=lambda x: int(x)):
+        n = rep["nodes"][nid]
+        stats = n.get("stats", {})
+        ctr = (n.get("telemetry") or {}).get("counters", {})
+        lines.append(
+            f"{nid:>5} {str(n.get('role', '?')):<10} "
+            f"{str(n.get('rank', '')):>5} "
+            f"{stats.get('max_rss_mb', float('nan')):>8.1f} "
+            f"{ctr.get('wire_bytes_out', 0):>12} "
+            f"{ctr.get('wire_bytes_in', 0):>12} "
+            f"{ctr.get('rpc_retries', 0):>8} "
+            f"{ctr.get('rpc_dedup_hits', 0):>6}"
+        )
+    merged = rep.get("merged", {})
+    lines.append("")
+    lines.append("cluster counters (merged):")
+    ctr = merged.get("counters", {})
+    for k in sorted(ctr):
+        lines.append(f"  {k:<28} {ctr[k]}")
+    lines.append("")
+    lines.append("per-command latency (merged across nodes):")
+    lines.append(format_latency_table(merged.get("hists", {})))
+    return "\n".join(lines)
 
 
 class ProgressReporter:
@@ -91,14 +325,22 @@ class ProgressReporter:
     computed collective-traffic estimate.
     """
 
-    _COLS = ("sec", "examples", "objv", "rel_objv", "auc", "nnz_w", "ex_per_sec")
+    _COLS = (
+        "sec", "examples", "objv", "rel_objv", "auc", "nnz_w", "ex_per_sec",
+        # recovery columns (merge_progress sums these cluster-wide; a table
+        # that never showed them hid the self-healing plane's activity)
+        "rpc_retries", "rpc_reconnects", "rpc_dedup_hits",
+    )
+    #: re-print the header periodically so long runs stay readable when
+    #: the top scrolled away (ref: glog's repeating table headers)
+    _HEADER_EVERY = 25
 
     def __init__(self, jsonl_path: str | Path | None = None, print_fn=print):
         self._path = Path(jsonl_path) if jsonl_path else None
         self._print = print_fn
         self._start = time.perf_counter()
         self._last_objv: float | None = None
-        self._header_printed = False
+        self._rows_since_header = self._HEADER_EVERY  # first row prints it
         self.history: list[dict[str, Any]] = []
 
     def report(self, **fields: Any) -> dict[str, Any]:
@@ -117,9 +359,10 @@ class ProgressReporter:
         return rec
 
     def _print_row(self, rec: dict[str, Any]) -> None:
-        if not self._header_printed:
+        if self._rows_since_header >= self._HEADER_EVERY:
             self._print("  ".join(f"{c:>12}" for c in self._COLS))
-            self._header_printed = True
+            self._rows_since_header = 0
+        self._rows_since_header += 1
         cells = []
         for c in self._COLS:
             v = rec.get(c, "")

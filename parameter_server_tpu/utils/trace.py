@@ -1,0 +1,409 @@
+"""Distributed tracing: spans across every process in the pod.
+
+Reference analog: the reference scheduler was a live dashboard fed by
+Progress protos and heartbeat stats, but "where did this step's 40 ms go"
+needed per-node timelines the reference never had. This module is that
+timeline: a low-overhead :class:`Tracer` whose spans export as Chrome
+trace-event JSON (load the file — or the merged file from
+:func:`merge_trace_dir` — at https://ui.perfetto.dev), with
+trace-id/parent-span propagation carried in the RPC header so one logical
+``push`` renders as client-span -> server-dispatch-span -> updater-span
+across processes.
+
+Design constraints, in order:
+
+1. **Disabled is free.** The default tracer is disabled; ``span()`` then
+   returns one process-global no-op singleton — no Span object, no dict,
+   no buffer append, nothing for the GC (tests assert the identity).
+   Instrumentation can therefore live permanently on hot paths.
+2. **Bounded.** Armed tracing records into a ring buffer
+   (``deque(maxlen=capacity)``): a week-long run keeps the newest spans
+   and never grows without bound.
+3. **Cross-process by construction.** ``ts`` is wall-clock microseconds
+   (the only clock two processes share), ``pid``/``tid`` are real OS ids,
+   and every span carries ``trace_id``/``span_id``/``parent_id`` in its
+   ``args`` so the RPC layer can stitch client and server timelines.
+
+Arming (same inheritance pattern as ``PS_FAULT_PLAN``): the
+``PS_TRACE_DIR`` env var arms the import-time global tracer — spawned
+multihost children inherit it for free; ``configure()`` re-arms
+explicitly (CLI ``--trace_dir`` / config ``[trace] trace_dir``). Each
+armed process writes ``trace-<name>-<pid>.json`` into the directory at
+exit (atexit backstop) or on ``tracer.flush()``.
+
+API sketch::
+
+    from parameter_server_tpu.utils import trace
+
+    with trace.span("step.pull", cat="step", bytes=n):   # context manager
+        ...
+    @trace.traced("load_shard")                          # decorator
+    def load_shard(...): ...
+    trace.instant("rpc.retry", addr=addr)                # point event
+
+    header["_trace"] = trace.wire_context()              # client side
+    with trace.activate(header.pop("_trace", None)):     # server side
+        ...spans here join the caller's trace...
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Callable
+
+TRACE_DIR_ENV = "PS_TRACE_DIR"
+
+#: ring-buffer default: ~64k spans x ~200 B/event ~= 13 MB ceiling per process
+DEFAULT_CAPACITY = 65536
+
+_current = threading.local()  # .span: innermost live span (or remote parent)
+
+
+def _now_us() -> float:
+    """Wall-clock microseconds: the only timebase two processes share, so
+    Perfetto lines up client and server spans on one axis."""
+    return time.time() * 1e6
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class _NoopSpan:
+    """The disabled-path singleton: enter/exit/set are all no-ops and no
+    instance is ever allocated per call — ``Tracer.span`` returns THIS
+    object every time when tracing is off (the "tracing disabled is
+    free" contract, asserted by tests)."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def set(self, **args: Any) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    """One live span (context manager). Recorded as a Chrome ``"X"``
+    (complete) event on exit; nesting via a thread-local stack gives
+    parent ids without any caller plumbing."""
+
+    __slots__ = (
+        "_tracer", "name", "cat", "trace_id", "span_id", "parent_id",
+        "args", "_t0_us", "_t0", "_prev",
+    )
+
+    def __init__(
+        self, tracer: "Tracer", name: str, cat: str,
+        trace_id: str, parent_id: str | None, args: dict[str, Any],
+    ):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.args = args
+
+    def set(self, **args: Any) -> None:
+        """Attach/override args after entry (e.g. reply byte counts)."""
+        self.args.update(args)
+
+    def __enter__(self) -> "Span":
+        self._t0_us = _now_us()
+        self._t0 = time.perf_counter()
+        self._prev = getattr(_current, "span", None)
+        _current.span = self
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        # duration from the monotonic clock (wall time can step); start
+        # from the wall clock (cross-process alignment)
+        dur_us = (time.perf_counter() - self._t0) * 1e6
+        _current.span = self._prev
+        if et is not None:
+            self.args.setdefault("error", repr(ev))
+        args = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            **({"parent_id": self.parent_id} if self.parent_id else {}),
+            **self.args,
+        }
+        self._tracer._record({
+            "name": self.name,
+            "cat": self.cat or "default",
+            "ph": "X",
+            "ts": self._t0_us,
+            "dur": dur_us,
+            "pid": os.getpid(),
+            "tid": threading.get_native_id(),
+            "args": args,
+        })
+        return False
+
+
+class _RemoteParent:
+    """Wire-borne span context installed by ``activate()``: spans opened
+    under it join the remote caller's trace instead of starting one."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+class _Activation:
+    __slots__ = ("_parent", "_prev")
+
+    def __init__(self, parent: _RemoteParent):
+        self._parent = parent
+
+    def __enter__(self) -> "_Activation":
+        self._prev = getattr(_current, "span", None)
+        _current.span = self._parent
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        _current.span = self._prev
+        return False
+
+
+class Tracer:
+    """Span recorder with a Chrome trace-event exporter. One module-global
+    instance (``trace.tracer``) serves the process; the module-level
+    ``span``/``instant``/... helpers delegate to whatever the global
+    currently is, so ``configure()`` can re-arm mid-process."""
+
+    def __init__(
+        self,
+        trace_dir: str | None = None,
+        capacity: int = DEFAULT_CAPACITY,
+        process_name: str = "",
+    ):
+        self._dir = trace_dir or None
+        self._buf: deque[dict[str, Any]] = deque(maxlen=max(capacity, 1))
+        self._lock = threading.Lock()
+        self.process_name = process_name or f"proc-{os.getpid()}"
+
+    @property
+    def enabled(self) -> bool:
+        return self._dir is not None
+
+    @property
+    def trace_dir(self) -> str | None:
+        return self._dir
+
+    # -- recording --------------------------------------------------------
+
+    def span(self, name: str, cat: str = "", **args: Any):
+        """Context manager for one span. Disabled path: returns the
+        process-global no-op singleton (no allocation)."""
+        if self._dir is None:
+            return _NOOP
+        cur = getattr(_current, "span", None)
+        if cur is not None:
+            return Span(self, name, cat, cur.trace_id, cur.span_id, args)
+        return Span(self, name, cat, _new_id(), None, args)
+
+    def instant(self, name: str, cat: str = "", **args: Any) -> None:
+        """Point-in-time annotation (retry fired, reconnect started);
+        rides the current span's trace when one is live."""
+        if self._dir is None:
+            return
+        cur = getattr(_current, "span", None)
+        if cur is not None:
+            args = {"trace_id": cur.trace_id, "parent_id": cur.span_id, **args}
+        self._record({
+            "name": name,
+            "cat": cat or "default",
+            "ph": "i",
+            "ts": _now_us(),
+            "s": "t",  # thread-scoped instant
+            "pid": os.getpid(),
+            "tid": threading.get_native_id(),
+            "args": args,
+        })
+
+    def wire_context(self) -> dict[str, str] | None:
+        """The current span's identity for an RPC header (``None`` when
+        disabled or outside any span — callers skip the header field)."""
+        if self._dir is None:
+            return None
+        cur = getattr(_current, "span", None)
+        if cur is None or cur.trace_id is None:
+            return None
+        return {"tid": cur.trace_id, "sid": cur.span_id}
+
+    def activate(self, ctx: dict[str, str] | None):
+        """Server side of propagation: bind a wire context as this
+        thread's parent so dispatch spans join the caller's trace."""
+        if self._dir is None or not ctx:
+            return _NOOP
+        return _Activation(_RemoteParent(ctx["tid"], ctx["sid"]))
+
+    def _record(self, ev: dict[str, Any]) -> None:
+        with self._lock:
+            self._buf.append(ev)
+
+    # -- inspection / export ----------------------------------------------
+
+    def events(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._buf)
+
+    def export(self, path: str) -> str:
+        """Write the buffered events as one strict Chrome trace-event JSON
+        object (``ts``-sorted, with process/thread ``M`` metadata), the
+        format Perfetto's legacy-JSON importer accepts."""
+        events = sorted(self.events(), key=lambda e: e["ts"])
+        pid = os.getpid()
+        meta: list[dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": self.process_name},
+        }]
+        for tid in sorted({e["tid"] for e in events}):
+            meta.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": f"thread-{tid}"},
+            })
+        doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def flush(self) -> str | None:
+        """Export into the armed trace dir (no-op when disabled or no
+        spans were recorded); returns the written path."""
+        if self._dir is None:
+            return None
+        if not self.events():
+            return None
+        name = f"trace-{self.process_name}-{os.getpid()}.json"
+        return self.export(os.path.join(self._dir, name))
+
+
+#: the process's tracer; armed at import when PS_TRACE_DIR is set so
+#: spawned children need no plumbing (the PS_FAULT_PLAN pattern)
+tracer = Tracer(os.environ.get(TRACE_DIR_ENV) or None)
+
+_atexit_armed = False
+
+
+def _flush_at_exit() -> None:  # pragma: no cover - interpreter teardown
+    try:
+        tracer.flush()
+    except Exception:
+        pass
+
+
+def _arm_atexit() -> None:
+    global _atexit_armed
+    if not _atexit_armed:
+        atexit.register(_flush_at_exit)
+        _atexit_armed = True
+
+
+if tracer.enabled:  # env-armed at import
+    _arm_atexit()
+
+
+def configure(
+    trace_dir: str | None,
+    capacity: int = DEFAULT_CAPACITY,
+    process_name: str = "",
+) -> Tracer:
+    """Replace the global tracer (arm with a dir, disarm with ``""``/
+    ``None``). The previous buffer is dropped — configure at process
+    start, before instrumented code runs."""
+    global tracer
+    tracer = Tracer(trace_dir or None, capacity, process_name)
+    if tracer.enabled:
+        _arm_atexit()
+    return tracer
+
+
+# -- module-level delegates (resolve the CURRENT global at call time, so
+# instrumented modules can `from ... import trace` once and still follow
+# configure()'s swaps) ------------------------------------------------------
+
+
+def span(name: str, cat: str = "", **args: Any):
+    return tracer.span(name, cat, **args)
+
+
+def instant(name: str, cat: str = "", **args: Any) -> None:
+    tracer.instant(name, cat, **args)
+
+
+def wire_context() -> dict[str, str] | None:
+    return tracer.wire_context()
+
+
+def activate(ctx: dict[str, str] | None):
+    return tracer.activate(ctx)
+
+
+def enabled() -> bool:
+    return tracer.enabled
+
+
+def traced(name: str | None = None, cat: str = "") -> Callable:
+    """Decorator form of ``span`` (checks the live global per call, so a
+    decorated function is free when tracing is off)."""
+
+    def deco(fn: Callable) -> Callable:
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a: Any, **kw: Any):
+            if not tracer.enabled:
+                return fn(*a, **kw)
+            with tracer.span(label, cat=cat):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def merge_trace_dir(trace_dir: str, out_name: str = "trace-merged.json") -> str:
+    """Combine every per-process ``trace-*.json`` in ``trace_dir`` into one
+    Perfetto-loadable file (distinct pids keep processes as separate
+    tracks). Returns the merged file's path."""
+    events: list[dict[str, Any]] = []
+    for fn in sorted(os.listdir(trace_dir)):
+        if not (fn.startswith("trace-") and fn.endswith(".json")):
+            continue
+        if fn == out_name:
+            continue
+        with open(os.path.join(trace_dir, fn)) as f:
+            doc = json.load(f)
+        events.extend(doc.get("traceEvents", []))
+    # stable cross-process ordering: metadata first, then by timestamp
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    out = os.path.join(trace_dir, out_name)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, out)
+    return out

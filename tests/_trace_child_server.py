@@ -1,0 +1,29 @@
+"""Child process for tests/test_trace_schema.py: one shard-server process
+with tracing armed via the PS_TRACE_DIR env var — the exact inheritance
+path spawned multihost nodes use. Prints its RPC address, serves until the
+parent's shutdown command, then exports its trace file.
+
+Usage: python _trace_child_server.py
+"""
+
+from __future__ import annotations
+
+
+def main() -> None:
+    import os
+
+    from parameter_server_tpu.kv.updaters import Sgd
+    from parameter_server_tpu.parallel.multislice import ShardServer
+    from parameter_server_tpu.utils import trace
+    from parameter_server_tpu.utils.keyrange import KeyRange
+
+    # env-armed at import already; re-configure for a readable export name
+    trace.configure(os.environ[trace.TRACE_DIR_ENV], process_name="server-0")
+    srv = ShardServer(Sgd(eta=0.1), KeyRange(0, 4096))
+    print("ADDR", srv.address, flush=True)
+    srv.serve_forever()  # until the parent's shutdown frame
+    trace.tracer.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,9 @@ def _full(sub_overrides=None, **top):
                     "quantized_vs_per_worker": 0.6},
         "ingest": {"parse_mb_per_sec": 400.0,
                    "parse_build_ex_per_sec": 6e5},
+        "wire_rpc": {"roundtrips_per_sec": 1200.0, "pull_p50_ms": 0.512,
+                     "pull_p99_ms": 2.048, "push_p50_ms": 0.512,
+                     "push_p99_ms": 4.096},
     }
     sub.update(sub_overrides or {})
     return {
@@ -54,7 +57,24 @@ class TestCompactContract:
                   "suite_wall_s", "full_results"):
             assert k in c, k
         assert set(c["sub"]) >= {"e2e", "ladder", "hbm", "scale", "w2v",
-                                 "mf", "darlin", "spmd", "wd", "ingest"}
+                                 "mf", "darlin", "spmd", "wd", "ingest",
+                                 "rpc"}
+
+    def test_telemetry_block_reaches_the_line(self):
+        c = bench._compact_contract(_full(), "f.json")
+        # the telemetry plane's RPC latency must ride the driver-recorded
+        # stdout line, not just the full results file
+        assert c["sub"]["rpc"] == {
+            "roundtrips_per_sec": 1200.0,
+            "pull_p50_ms": 0.512,
+            "push_p99_ms": 4.096,
+        }
+
+    def test_wire_rpc_error_still_fits_and_is_marked(self):
+        full = _full(sub_overrides={"wire_rpc": {"error": "boom " * 100}})
+        line = json.dumps(bench._compact_contract(full, "f.json"))
+        assert len(line) < 1500
+        assert "error" in json.loads(line)["sub"]["rpc"]
 
     def test_every_child_erroring_still_fits(self):
         sub = {k: {"error": "x" * 600} for k in _full()["sub"]}

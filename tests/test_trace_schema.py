@@ -1,0 +1,248 @@
+"""Trace-event schema validation from a LIVE 2-process run (the tentpole
+acceptance test): a worker process (this one) pushes/pulls against a shard
+server spawned as a real OS child with tracing armed via PS_TRACE_DIR.
+Both processes export Chrome trace-event JSON; the suite asserts strict
+schema (monotonic ts, valid ph types, X durations) and that one logical
+``push`` carries ONE trace id through the client span (worker file) and
+the server dispatch + updater spans (server file)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.utils import trace
+
+_VALID_PH = {"X", "i", "M"}
+
+
+def _validate_chrome_trace(path: Path) -> list[dict]:
+    """Strict-JSON Chrome trace-event checks; returns the event list."""
+    doc = json.loads(path.read_text())  # strict JSON or die
+    assert isinstance(doc, dict) and "traceEvents" in doc
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    last_ts = None
+    for ev in events:
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert ev["ph"] in _VALID_PH, ev
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "M":
+            continue
+        assert ev["ts"] >= 0
+        if last_ts is not None:  # export sorts: ts must be monotonic
+            assert ev["ts"] >= last_ts
+        last_ts = ev["ts"]
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    return events
+
+
+def _spans(events: list[dict], name: str) -> list[dict]:
+    return [e for e in events if e.get("ph") == "X" and e["name"] == name]
+
+
+class TestTwoProcessTrace:
+    def test_push_trace_id_spans_both_processes(self, tmp_path):
+        from parameter_server_tpu.parallel.multislice import ServerHandle
+        from parameter_server_tpu.utils.config import PSConfig
+
+        trace_dir = tmp_path / "traces"
+        trace_dir.mkdir()
+        repo = Path(__file__).resolve().parent.parent
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(repo) + os.pathsep + env.get("PYTHONPATH", "")
+        env[trace.TRACE_DIR_ENV] = str(trace_dir)
+        child = subprocess.Popen(
+            [sys.executable, str(Path(__file__).parent / "_trace_child_server.py")],
+            stdout=subprocess.PIPE, text=True, env=env,
+        )
+        try:
+            line = child.stdout.readline()  # "ADDR host:port"
+            assert line.startswith("ADDR "), line
+            addr = line.split()[1]
+
+            trace.configure(str(trace_dir), process_name="worker-0")
+            try:
+                handle = ServerHandle(addr, 0, 0, PSConfig(), range_size=4096)
+                keys = np.arange(1, 65, dtype=np.int64)
+                g = np.full(len(keys), 0.5, dtype=np.float32)
+                handle.push(keys, g)
+                w = handle.pull(keys)
+                np.testing.assert_allclose(w, -0.1 * g, rtol=1e-6)
+                handle.shutdown()
+                handle.close()
+                child.wait(timeout=60)
+                worker_path = Path(trace.tracer.flush())
+            finally:
+                trace.configure(None)  # restore the disabled default
+
+            server_files = [
+                p for p in trace_dir.glob("trace-server-0-*.json")
+            ]
+            assert server_files, list(trace_dir.iterdir())
+            worker_ev = _validate_chrome_trace(worker_path)
+            server_ev = _validate_chrome_trace(server_files[0])
+
+            # the two processes export distinct pids (separate Perfetto
+            # tracks when merged)
+            wpids = {e["pid"] for e in worker_ev if e["ph"] == "X"}
+            spids = {e["pid"] for e in server_ev if e["ph"] == "X"}
+            assert wpids and spids and wpids.isdisjoint(spids)
+
+            # one logical push = one trace id across processes:
+            # ps.push (worker) -> rpc.push (worker) -> rpc.serve.push
+            # (server) -> server.updater (server)
+            push_spans = _spans(worker_ev, "ps.push")
+            assert push_spans, [e["name"] for e in worker_ev]
+            tid = push_spans[0]["args"]["trace_id"]
+            client_rpc = [
+                e for e in _spans(worker_ev, "rpc.push")
+                if e["args"]["trace_id"] == tid
+            ]
+            assert client_rpc, "client rpc.push span missing from trace"
+            serve = [
+                e for e in _spans(server_ev, "rpc.serve.push")
+                if e["args"]["trace_id"] == tid
+            ]
+            assert serve, "server dispatch span did not join the trace"
+            updater = [
+                e for e in _spans(server_ev, "server.updater")
+                if e["args"]["trace_id"] == tid
+            ]
+            assert updater, "updater span did not join the trace"
+            # parent chain: dispatch's parent is the client rpc span
+            assert serve[0]["args"]["parent_id"] == client_rpc[0]["args"]["span_id"]
+
+            # the merged file is itself schema-valid and holds both pids
+            merged = Path(trace.merge_trace_dir(str(trace_dir)))
+            merged_ev = _validate_chrome_trace(merged)
+            assert {e["pid"] for e in merged_ev if e["ph"] == "X"} >= wpids | spids
+        finally:
+            if child.poll() is None:
+                child.kill()
+                child.wait()
+            child.stdout.close()
+
+
+class TestDisabledTracingIsFree:
+    def test_noop_path_allocates_no_spans(self):
+        t = trace.Tracer(None)
+        s1 = t.span("hot.path", cat="step", keys=128)
+        s2 = t.span("other")
+        # ONE process-global singleton — no Span object, no args dict kept
+        assert s1 is s2 is trace._NOOP
+        with s1 as s:
+            s.set(bytes=4096)  # no-op, no storage
+        assert t.events() == []
+        assert t.wire_context() is None
+        assert t.activate({"tid": "x", "sid": "y"}) is trace._NOOP
+        t.instant("nope")
+        assert t.events() == []
+        assert t.flush() is None
+
+    def test_noop_is_reference_stable_across_calls(self):
+        # the disabled global tracer hands out the identical object every
+        # time: the hot-path cost is one method call, zero allocations of
+        # spans (the "tracing disabled is free" contract bench relies on)
+        t = trace.Tracer(None)
+        assert len({id(t.span(f"s{i}")) for i in range(100)}) == 1
+
+    def test_traced_decorator_free_when_disabled(self):
+        calls = []
+
+        @trace.traced("decorated.fn")
+        def fn(x):
+            calls.append(x)
+            return x + 1
+
+        assert fn(1) == 2 and calls == [1]
+        assert trace.tracer.events() == []
+
+
+class TestTracerEnabled:
+    @pytest.fixture
+    def armed(self, tmp_path):
+        t = trace.configure(str(tmp_path), process_name="t")
+        yield t
+        trace.configure(None)
+
+    def test_nesting_and_parent_ids(self, armed):
+        with trace.span("outer", cat="a") as o:
+            with trace.span("inner", cat="b") as i:
+                assert i.trace_id == o.trace_id
+                assert i.parent_id == o.span_id
+        evs = armed.events()
+        names = [e["name"] for e in evs]
+        assert names == ["inner", "outer"]  # recorded at exit
+
+    def test_wire_context_roundtrip_in_process(self, armed):
+        with trace.span("client.side") as c:
+            ctx = trace.wire_context()
+            assert ctx == {"tid": c.trace_id, "sid": c.span_id}
+        with trace.activate(ctx), trace.span("server.side") as s:
+            assert s.trace_id == c.trace_id
+            assert s.parent_id == c.span_id
+
+    def test_ring_buffer_bounded(self, tmp_path):
+        t = trace.Tracer(str(tmp_path), capacity=8)
+        for i in range(50):
+            with t.span(f"s{i}"):
+                pass
+        assert len(t.events()) == 8
+        assert t.events()[-1]["name"] == "s49"  # newest kept
+
+    def test_export_schema_and_error_annotation(self, armed, tmp_path):
+        with pytest.raises(ValueError):
+            with trace.span("boom"):
+                raise ValueError("x")
+        with trace.span("ok", answer=42):
+            time.sleep(0.001)
+        path = Path(armed.flush())
+        evs = _validate_chrome_trace(path)
+        by_name = {e["name"]: e for e in evs if e["ph"] == "X"}
+        assert "error" in by_name["boom"]["args"]
+        assert by_name["ok"]["args"]["answer"] == 42
+        assert by_name["ok"]["dur"] >= 900  # ~the 1ms sleep, in us
+
+    def test_instant_rides_current_trace(self, armed):
+        with trace.span("call") as c:
+            trace.instant("rpc.retry", attempt=1)
+        inst = [e for e in armed.events() if e["ph"] == "i"]
+        assert inst and inst[0]["args"]["trace_id"] == c.trace_id
+
+    def test_step_context_carries_onto_pool_threads(self, armed):
+        # thread locals don't cross ThreadPoolExecutor: the worker loop
+        # captures the step span's context and re-activates it on pool
+        # threads (_with_trace_ctx), so per-server RPC spans join the
+        # step's trace instead of starting their own
+        from concurrent.futures import ThreadPoolExecutor
+
+        from parameter_server_tpu.parallel.multislice import _with_trace_ctx
+
+        def pool_side():
+            with trace.span("ps.pull"):
+                return True
+
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            with trace.span("step") as stp:
+                ctx = trace.wire_context()
+                bare = pool.submit(pool_side).result()
+                linked = pool.submit(
+                    _with_trace_ctx, ctx, pool_side
+                ).result()
+            assert bare and linked
+        pulls = _spans(armed.events(), "ps.pull")
+        assert len(pulls) == 2
+        tids = {e["args"]["trace_id"] for e in pulls}
+        # one joined the step's trace, the bare one started its own
+        assert stp.trace_id in tids and len(tids) == 2
+        joined = [
+            e for e in pulls if e["args"]["trace_id"] == stp.trace_id
+        ]
+        assert joined[0]["args"]["parent_id"] == stp.span_id
