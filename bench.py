@@ -65,12 +65,19 @@ Sub-benches ("sub"):
                  quantized wire most.
   ingest       — host-side native parse MB/s + parse+localize ex/s per
                  stream (bounds e2e on co-located hardware).
-  wire_rpc     — loopback RPC tier microbench (ShardServer + ServerHandle
-                 over real TCP): pull/push round-trips/sec and p50/p99
+  wire_rpc     — loopback RPC tier microbench: (1) ShardServer +
+                 ServerHandle over real TCP (one handle reused across
+                 repeats): pull/push round-trips/sec and p50/p99
                  client-observed latency from the telemetry plane's
-                 log-bucketed histograms; its process telemetry snapshot
-                 is embedded in the full results as "telemetry", so
-                 BENCH_* rounds track RPC latency alongside throughput.
+                 log-bucketed histograms; (2) pipelined-vs-lockstep push
+                 round trips at window W=8 against a separate-process ack
+                 server (the async engine's headline ratio); (3) a
+                 4 KiB -> 4 MiB payload sweep reporting MB/s for lockstep
+                 vs pipelined through the zero-copy frame path plus a
+                 compressible cell exercising the adaptive-zip probe. Its
+                 process telemetry snapshot is embedded in the full
+                 results as "telemetry", so BENCH_* rounds track RPC
+                 latency alongside throughput.
   last_tpu_capture — present only on a CPU fallback: names the newest
                  committed BENCH_r*_local.json real-hardware capture.
 """
@@ -111,7 +118,7 @@ CHILD_BUDGET_S = {
     "wd_push": 420,
     "darlin": 300,
     "ingest": 240,
-    "wire_rpc": 180,
+    "wire_rpc": 300,
 }
 # run order = value order: the contract fields land first, platform-bound
 # numbers next, platform-independent ones last
@@ -1055,13 +1062,39 @@ def child_ingest() -> dict:
     return out
 
 
+_ACK_SERVER_CODE = """
+import sys
+sys.path.insert(0, {repo!r})
+from parameter_server_tpu.parallel.control import RpcServer
+srv = RpcServer(lambda h, a: ({{"ok": True}}, {{}})).start()
+print("ADDR", srv.address, flush=True)
+while not srv._stop.wait(0.5):
+    pass
+"""
+
+
 def child_wire_rpc() -> dict:
-    """Loopback RPC tier microbench: a real ShardServer + ServerHandle
-    over TCP in one process — pull/push round-trips/sec plus the p50/p99
-    client-observed latencies the new telemetry plane records per
-    command. The process's merged telemetry snapshot rides along so the
-    full results file starts tracking RPC latency next to throughput."""
+    """Loopback RPC tier microbench, three blocks:
+
+    1. A real ShardServer + ServerHandle over TCP in one process —
+       pull/push round-trips/sec plus the p50/p99 client-observed
+       latencies the telemetry plane records per command. ONE handle is
+       reused for every repeat, so connection setup never pollutes p50.
+    2. Pipelined-vs-lockstep push round trips at W=8 against an ack
+       RpcServer in a SEPARATE process (same-process client+server share
+       a GIL and mask the overlap the async engine exists for).
+    3. A payload-size sweep (4 KiB -> 4 MiB) reporting MB/s for lockstep
+       vs W=8 pipelined pushes through the zero-copy frame path, plus a
+       compressible 1 MiB cell showing the adaptive-zip savings counter.
+
+    The process's merged telemetry snapshot rides along so the full
+    results file tracks RPC latency next to throughput."""
+    import statistics as stats
+    import subprocess
+    import sys as sys_mod
+
     from parameter_server_tpu.kv.updaters import Ftrl
+    from parameter_server_tpu.parallel.control import RpcClient
     from parameter_server_tpu.parallel.multislice import ServerHandle, ShardServer
     from parameter_server_tpu.utils.config import PSConfig
     from parameter_server_tpu.utils.keyrange import KeyRange
@@ -1069,8 +1102,10 @@ def child_wire_rpc() -> dict:
         hist_percentile,
         latency_histograms,
         telemetry_snapshot,
+        wire_counters,
     )
 
+    # -- block 1: real ShardServer round trips (handle reused throughout)
     n_keys, iters = 1 << 18, 300
     srv = ShardServer(
         Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2),
@@ -1100,8 +1135,141 @@ def child_wire_rpc() -> dict:
         if s:
             out[f"{cmd}_p50_ms"] = round(hist_percentile(s, 0.5) * 1e3, 3)
             out[f"{cmd}_p99_ms"] = round(hist_percentile(s, 0.99) * 1e3, 3)
+    # W=8 pipelined pushes against the SAME ShardServer (updater applies
+    # serialize server-side; the win is the removed per-call lockstep)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        handle.push(keys, g)
+    out["push_rps_shard_lockstep"] = round(
+        iters / (time.perf_counter() - t0), 1
+    )
+    t0 = time.perf_counter()
+    futs = [handle.push_async(keys, g) for _ in range(iters)]
+    for f in futs:
+        f.result()
+    out["push_rps_shard_pipelined_w8"] = round(
+        iters / (time.perf_counter() - t0), 1
+    )
     handle.shutdown()
     handle.close()
+
+    # -- blocks 2+3: ack server in its own process (no shared GIL)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    ack = subprocess.Popen(
+        [sys_mod.executable, "-c", _ACK_SERVER_CODE.format(repo=repo)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        line = ack.stdout.readline()
+        if not line.startswith("ADDR "):
+            # died before binding: surface ITS error, not an IndexError
+            err = (ack.stderr.read() or "no stderr").strip()[-400:]
+            raise RuntimeError(f"ack server failed to start: {err}")
+        addr = line.split()[1]
+        payload = {  # a per-shard push segment's shape (matches block 1)
+            "keys": np.arange(1024, dtype=np.uint32),
+            "g": rng.normal(size=1024).astype(np.float32),
+        }
+        lockstep = RpcClient(addr, window=1)
+        pipelined = RpcClient(addr, window=8)
+        for cli in (lockstep, pipelined):  # settle TCP + warm both paths
+            fs = [cli.call_async("push", arrays=payload) for _ in range(100)]
+            for f in fs:
+                f.result()
+
+        def _rps_lockstep(n: int) -> float:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                lockstep.call("push", arrays=payload)
+            return n / (time.perf_counter() - t0)
+
+        def _rps_pipelined(n: int) -> float:
+            t0 = time.perf_counter()
+            fs = [pipelined.call_async("push", arrays=payload) for _ in range(n)]
+            for f in fs:
+                f.result()
+            return n / (time.perf_counter() - t0)
+
+        # INTERLEAVED rounds, median per-round ratio: shared-host noise
+        # (this is a loopback bench on whatever machine the driver uses)
+        # hits both modes of a round alike instead of biasing one side
+        rounds = [
+            (_rps_lockstep(500), _rps_pipelined(500)) for _ in range(5)
+        ]
+        ls = stats.median(r[0] for r in rounds)
+        pp = stats.median(r[1] for r in rounds)
+        out["push_rps_lockstep"] = round(ls, 1)
+        out["push_rps_pipelined_w8"] = round(pp, 1)
+        out["pipelined_speedup_w8"] = round(
+            stats.median(p / l for l, p in rounds), 2
+        )
+
+        # payload sweep: incompressible float32 with zip=True — the
+        # adaptive probe must DECLINE every one of these (zlib on random
+        # grads is pure CPU loss), so the sweep rides the probe-and-skip
+        # path production compressed runs take. Same interleaved-rounds
+        # discipline as the headline ratio.
+        skipped0 = wire_counters.get("wire_comp_skipped")
+        sweep: dict = {}
+        for kib in (4, 64, 1024, 4096):
+            nb = kib << 10
+            arr = {"g": rng.normal(size=nb // 4).astype(np.float32)}
+            reps = max(8, min(200, (16 << 20) // nb))
+            cells = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    lockstep.call("push", arrays=arr, zip=True)
+                mb_ls = nb * reps / (time.perf_counter() - t0) / 1e6
+                t0 = time.perf_counter()
+                fs = [
+                    pipelined.call_async("push", arrays=arr, zip=True)
+                    for _ in range(reps)
+                ]
+                for f in fs:
+                    f.result()
+                mb_pp = nb * reps / (time.perf_counter() - t0) / 1e6
+                cells.append((mb_ls, mb_pp))
+            sweep[f"{kib}KiB"] = {
+                "lockstep_mb_s": round(stats.median(c[0] for c in cells), 1),
+                "pipelined_mb_s": round(stats.median(c[1] for c in cells), 1),
+                "speedup": round(
+                    stats.median(c[1] / c[0] for c in cells), 2
+                ),
+            }
+        out["sweep"] = sweep
+        out["mb_s_1mib_pipelined"] = sweep["1024KiB"]["pipelined_mb_s"]
+
+        # compressible cell: zeros under zip=True — the probe accepts,
+        # and the savings land in the wire_bytes_saved counter
+        saved0 = wire_counters.get("wire_bytes_saved")
+        z = {"g": np.zeros(1 << 18, np.float32)}
+        t0 = time.perf_counter()
+        fs = [
+            pipelined.call_async("push", arrays=z, zip=True)
+            for _ in range(40)
+        ]
+        for f in fs:
+            f.result()
+        out["comp_mb_s_1mib_zip"] = round(
+            40 * (1 << 20) / (time.perf_counter() - t0) / 1e6, 1
+        )
+        out["wire_bytes_saved"] = wire_counters.get("wire_bytes_saved") - saved0
+        # delta over this child's sweep (same semantics as bytes_saved):
+        # every incompressible sweep array must have been probe-declined
+        out["wire_comp_skipped"] = (
+            wire_counters.get("wire_comp_skipped") - skipped0
+        )
+        lockstep.close()
+        pipelined.close()
+    finally:
+        ack.kill()
+        try:
+            ack.wait(timeout=10)  # reap: no zombie for the suite's life
+        except subprocess.TimeoutExpired:
+            pass
+        ack.stdout.close()
+        ack.stderr.close()
     out["telemetry"] = telemetry_snapshot()
     return out
 
@@ -1410,11 +1578,13 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
                 "quantized_vs_per_worker"),
             "ingest": _pick(
                 "ingest", "parse_mb_per_sec", "parse_build_ex_per_sec"),
-            # the telemetry block: RPC latency reaches the driver-recorded
-            # line, not just the full results file
+            # the telemetry block: RPC latency + the pipelined wire's
+            # headline ratios reach the driver-recorded line, not just
+            # the full results file
             "rpc": _pick(
                 "wire_rpc", "roundtrips_per_sec", "pull_p50_ms",
-                "push_p99_ms"),
+                "push_p99_ms", "pipelined_speedup_w8",
+                "mb_s_1mib_pipelined"),
         },
     }
     if "last_tpu_capture" in full:

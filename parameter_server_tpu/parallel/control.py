@@ -19,10 +19,25 @@ Wire format (ref: Message = Task proto header + SArray payloads):
     u32 header_len | u32 payload_len | header JSON | payload bytes
 
 The header carries the command and scalar fields; ``arrays`` in the header
-describes the (name, dtype, shape) of each contiguous numpy payload. With
-``zip`` set the payload block is zlib-compressed (ref: the compressing
-filter, src/filter/compressing.h — byte compression earns its place back on
-a real wire).
+describes the (name, dtype, shape, compressed_len) of each contiguous numpy
+payload chunk. The payload path is zero-copy end to end: ``send_frame``
+gathers the length word, the header, and each array's ``memoryview``
+straight into ``socket.sendmsg`` (no ``tobytes``/``join`` concatenation),
+and the receiver lands the whole payload in ONE preallocated buffer that
+``np.frombuffer`` views without copying. With ``zip`` set, compression is
+per-array and adaptive (ref: the compressing filter,
+src/filter/compressing.h): integer key lists and quantized int8/int16
+payloads stay raw, arrays under a size floor stay raw, and larger float
+arrays are compressed only when a sampled probe says zlib actually wins —
+the bytes saved (and probes that declined) land in the process-global
+``wire_bytes_saved`` / ``wire_comp_skipped`` counters (ref: the Postoffice
+per-filter byte counters).
+
+Pipelining: ``RpcClient.call_async`` keeps up to ``window`` seq-numbered
+requests in flight per connection; a reader thread completes their futures
+as replies arrive (matched by the ``_rseq`` echo). ``call`` is now just
+``call_async(...).result()`` — so N threads sharing one client overlap
+their round trips instead of serializing under a lock.
 
 Delivery semantics (ref: the paper's vector-clock idempotent
 retransmission, rebuilt for this wire format): every ``RpcClient`` request
@@ -46,6 +61,7 @@ import time
 import uuid
 import zlib
 from collections import OrderedDict
+from concurrent.futures import Future
 from typing import Any, Callable
 
 import numpy as np
@@ -67,8 +83,16 @@ _LEN = struct.Struct("<II")
 
 Arrays = dict[str, np.ndarray]
 
+# adaptive per-array compression (the compressing filter, rebuilt):
+_COMP_MIN_BYTES = 1024  # arrays below this floor are never worth the CPU
+_COMP_PROBE_BYTES = 4096  # sampled-ratio window for large arrays
+_COMP_PROBE_RATIO = 0.9  # the probe must beat this or the array stays raw
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+
+def _recv_exact(sock: socket.socket, n: int) -> memoryview:
+    """Read exactly ``n`` bytes into ONE preallocated buffer and return a
+    view of it — no trailing ``bytes(buf)`` copy; ``np.frombuffer`` on the
+    receive side views this buffer directly."""
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
@@ -77,7 +101,137 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         if k == 0:
             raise ConnectionError("peer closed")
         got += k
-    return bytes(buf)
+    return view
+
+
+class FrameReader:
+    """Buffered socket reads for a frame stream. Small reads (length
+    words, headers, small payloads) are served from one shared buffer
+    filled by large recv calls — ~1 syscall per small frame instead of 3,
+    and a burst of pipelined replies often lands in ONE recv. Reads with
+    an empty buffer that exceed its capacity fall through to a direct
+    ``recv_into`` (multi-MiB payloads keep the single-landing-buffer
+    zero-copy path with no intermediate hop).
+
+    Duck-typed as the ``recv_into`` side of a socket so
+    ``recv_frame_sized`` accepts either; each reader owns ONE stream
+    (the per-connection reader threads), never a shared socket."""
+
+    __slots__ = ("_sock", "_buf", "_lo", "_hi")
+
+    def __init__(self, sock: socket.socket, cap: int = 1 << 16):
+        self._sock = sock
+        self._buf = memoryview(bytearray(cap))
+        self._lo = 0
+        self._hi = 0
+
+    def buffered(self) -> bool:
+        """More bytes already landed? (The server's reply-coalescing cue:
+        while requests are queued in the buffer, replies batch into one
+        gather write; the moment input drains, replies flush — so a
+        lockstep caller never waits on a withheld reply.)"""
+        return self._hi > self._lo
+
+    def recv_into(self, view, n: int) -> int:
+        avail = self._hi - self._lo
+        if avail == 0:
+            if n >= len(self._buf):
+                return self._sock.recv_into(view, n)  # big read: direct
+            self._lo = 0
+            k = self._sock.recv_into(self._buf)
+            if k == 0:
+                return 0
+            self._hi = k
+            avail = k
+        take = min(avail, n)
+        view[:take] = self._buf[self._lo : self._lo + take]
+        self._lo += take
+        return take
+
+
+def _compressible(a: np.ndarray) -> bool:
+    """Only real-float payloads above the floor are candidates: integer key
+    lists and quantized int8/int16 (and f16) chunks are already dense."""
+    return a.dtype.kind == "f" and a.itemsize >= 4 and a.nbytes >= _COMP_MIN_BYTES
+
+
+def _try_compress(view) -> bytes | None:
+    """zlib level-1 with an adaptive probe: sample the head of a large
+    array first — random float32 gradients cost CPU for ~0% savings, so an
+    unpromising ratio skips the full pass. Returns None to send raw."""
+    n = len(view)
+    if n > _COMP_PROBE_BYTES:
+        probe = zlib.compress(view[:_COMP_PROBE_BYTES], 1)
+        if len(probe) > _COMP_PROBE_RATIO * _COMP_PROBE_BYTES:
+            wire_counters.inc("wire_comp_skipped")
+            return None
+    comp = zlib.compress(view, 1)
+    if len(comp) >= n:
+        wire_counters.inc("wire_comp_skipped")
+        return None
+    return comp
+
+
+def _send_gather(sock, bufs: list) -> None:
+    """Gather-write a frame's buffers with one-or-few ``sendmsg`` calls —
+    the zero-copy half of send_frame. Transports without sendmsg (test
+    sinks, exotic sockets) fall back to a single joined sendall."""
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is None:
+        sock.sendall(b"".join(bufs))
+        return
+    wire_counters.inc("wire_frames_zero_copy")
+    views = [memoryview(b) for b in bufs if len(b)]
+    while views:
+        sent = sendmsg(views[:1024])  # IOV_MAX guard for coalesced batches
+        while sent:  # partial gather writes happen at multi-MiB payloads
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
+def build_frame(
+    header: dict[str, Any], arrays: Arrays | None = None
+) -> tuple[list, int]:
+    """Encode one framed message as a list of gather buffers (length word,
+    header bytes, then each array's memoryview — no tobytes/join copies)
+    plus its total wire size. Callers hand the buffers to one gather
+    write, possibly COALESCED with other frames' buffers (the pipelined
+    client's flusher batches a window of small frames into a single
+    sendmsg). With ``zip`` in the header each eligible array is
+    compressed only when the adaptive probe says it wins (meta entry:
+    compressed length, 0 = raw)."""
+    arrays = arrays or {}
+    metas = []
+    bufs: list = []
+    plen = 0
+    zip_ok = bool(header.get("zip"))
+    for name, a in arrays.items():
+        a = np.ascontiguousarray(a)
+        chunk = memoryview(a).cast("B") if a.ndim else a.tobytes()
+        clen = 0
+        if zip_ok and _compressible(a):
+            comp = _try_compress(chunk)
+            if comp is not None:
+                wire_counters.inc("wire_bytes_saved", a.nbytes - len(comp))
+                chunk = comp
+                clen = len(comp)
+        metas.append([name, a.dtype.str, list(a.shape), clen])
+        bufs.append(chunk)
+        plen += len(chunk)
+    h = dict(header)
+    h["arrays"] = metas
+    hb = json.dumps(h).encode()
+    nbytes = _LEN.size + len(hb) + plen
+    # frame-layer byte accounting: EVERY framed message — coordinator and
+    # control traffic included — lands in the process-global counters, so
+    # the cluster's wire-byte columns no longer undercount to just the
+    # ServerHandle data plane
+    wire_counters.inc("wire_bytes_out", nbytes)
+    return [_LEN.pack(len(hb), plen), hb, *bufs], nbytes
 
 
 def send_frame(
@@ -85,50 +239,38 @@ def send_frame(
 ) -> int:
     """Send one framed message; returns bytes put on the wire (ref: the
     Postoffice per-message byte counters)."""
-    arrays = arrays or {}
-    metas = []
-    chunks = []
-    for name, a in arrays.items():
-        a = np.ascontiguousarray(a)
-        metas.append([name, a.dtype.str, list(a.shape)])
-        chunks.append(a.tobytes())
-    payload = b"".join(chunks)
-    if header.get("zip"):
-        payload = zlib.compress(payload, level=1)
-    h = dict(header)
-    h["arrays"] = metas
-    hb = json.dumps(h).encode()
-    frame = _LEN.pack(len(hb), len(payload)) + hb + payload
-    sock.sendall(frame)
-    # frame-layer byte accounting: EVERY framed message — coordinator and
-    # control traffic included — lands in the process-global counters, so
-    # the cluster's wire-byte columns no longer undercount to just the
-    # ServerHandle data plane
-    wire_counters.inc("wire_bytes_out", len(frame))
-    return len(frame)
+    bufs, nbytes = build_frame(header, arrays)
+    _send_gather(sock, bufs)
+    return nbytes
 
 
 def recv_frame_sized(
     sock: socket.socket,
 ) -> tuple[dict[str, Any], Arrays, int]:
-    """recv_frame plus the frame's wire size (for traffic counters)."""
+    """recv_frame plus the frame's wire size (for traffic counters).
+
+    Raw array chunks are returned as ``np.frombuffer`` views of the single
+    preallocated receive buffer — zero copies on the landing path;
+    compressed chunks (meta compressed_len > 0) decompress per array."""
     hlen, plen = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    header = json.loads(_recv_exact(sock, hlen))
-    payload = _recv_exact(sock, plen) if plen else b""
+    header = json.loads(_recv_exact(sock, hlen).tobytes())
+    payload = _recv_exact(sock, plen) if plen else memoryview(b"")
     nbytes = _LEN.size + hlen + plen
     wire_counters.inc("wire_bytes_in", nbytes)  # frame layer (see send_frame)
-    if header.get("zip"):
-        payload = zlib.decompress(payload)
     arrays: Arrays = {}
     off = 0
-    for name, dtype, shape in header.pop("arrays", []):
+    for name, dtype, shape, clen in header.pop("arrays", []):
         dt = np.dtype(dtype)
         n = int(np.prod(shape)) if shape else 1
-        nb = n * dt.itemsize
-        arrays[name] = np.frombuffer(
-            payload, dtype=dt, count=n, offset=off
-        ).reshape(shape)
-        off += nb
+        if clen:
+            raw = zlib.decompress(payload[off : off + clen])
+            arrays[name] = np.frombuffer(raw, dtype=dt, count=n).reshape(shape)
+            off += clen
+        else:
+            arrays[name] = np.frombuffer(
+                payload, dtype=dt, count=n, offset=off
+            ).reshape(shape)
+            off += n * dt.itemsize
     return header, arrays, nbytes
 
 
@@ -150,9 +292,11 @@ class _DedupEntry:
         self.arrays: Arrays | None = None
 
 
-# Reply-cache bounds: clients serialize requests, so at most one entry per
-# client is ever truly live; small slack absorbs pathological interleavings.
-_DEDUP_PER_CLIENT = 4
+# Reply-cache bounds: a pipelined client may hold a full window of
+# non-idempotent requests in flight, and a reconnect resends them ALL — the
+# per-client cache must cover the window (with slack for bounce re-issues)
+# or a resent, already-applied push would miss the cache and double-apply.
+_DEDUP_PER_CLIENT = 64
 _DEDUP_CLIENTS = 1024
 
 
@@ -178,8 +322,14 @@ class RpcServer:
         fault_plan: FaultPlan | None = None,
         idempotent_cmds: frozenset[str] = frozenset(),
         expose_identity: bool = False,
+        blocking_cmds: frozenset[str] = frozenset(),
     ):
         self._handler = handler
+        # commands whose handler may PARK the connection thread (barrier,
+        # ssp_wait, blocking kv_get): coalesced replies must flush before
+        # dispatching one, or earlier requests' replies would be withheld
+        # for as long as the blocking command parks
+        self._blocking_cmds = blocking_cmds
         # re-applying these is harmless, so resends bypass the reply cache
         # entirely — caching their (potentially large: pull/dump/kv_get
         # payloads) replies would pin the arrays of the last
@@ -221,6 +371,30 @@ class RpcServer:
 
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        reader = FrameReader(conn)  # this thread owns the receive side
+        # reply coalescing: while further requests sit in the read buffer
+        # (a pipelined burst), replies accumulate and flush as ONE gather
+        # write; with nothing buffered the reply flushes immediately, so
+        # lockstep latency is untouched
+        out_bufs: list = []
+        out_n = 0
+        out_frames = 0
+
+        def queue_reply(rep: dict[str, Any], rep_arrays: Arrays | None) -> None:
+            nonlocal out_n, out_frames
+            fb, n = build_frame(rep, rep_arrays)
+            out_bufs.extend(fb)
+            out_n += n
+            out_frames += 1
+
+        def flush_replies() -> None:
+            nonlocal out_bufs, out_n, out_frames
+            if not out_bufs:
+                return
+            _send_gather(conn, out_bufs)
+            with self._counter_lock:
+                self.bytes_out += out_n
+            out_bufs, out_n, out_frames = [], 0, 0
         with self._counter_lock:
             self._conns.add(conn)
         # register-then-check pairs with stop()'s set-then-sever: a conn
@@ -236,7 +410,7 @@ class RpcServer:
             return
         try:
             while True:
-                header, arrays, nbytes = recv_frame_sized(conn)
+                header, arrays, nbytes = recv_frame_sized(reader)
                 with self._counter_lock:
                     self.bytes_in += nbytes
                     self.frames_in += 1
@@ -246,6 +420,12 @@ class RpcServer:
                     else None
                 )
                 if fault is not None and fault.action == "drop":
+                    # the fault models THIS request lost on the wire, not
+                    # the whole batch: earlier requests' withheld replies
+                    # still go out (as they did pre-coalescing), or a
+                    # periodic drop would livelock a pipelined client —
+                    # every resend round re-killed before any reply lands
+                    flush_replies()
                     return  # request lost before it applied; conn closed below
                 if fault is not None and fault.action == "delay":
                     time.sleep(fault.delay_s)
@@ -259,6 +439,8 @@ class RpcServer:
                     if fault is not None and fault.action == "duplicate"
                     else None
                 )
+                if out_bufs and cmd_name in self._blocking_cmds:
+                    flush_replies()  # see blocking_cmds in __init__
                 t_svc = time.perf_counter()
                 try:
                     # activate() binds the wire-borne trace context so the
@@ -280,7 +462,11 @@ class RpcServer:
                     )
                 except RpcServer.Shutdown:
                     try:
-                        send_frame(conn, {"ok": True})
+                        ack: dict[str, Any] = {"ok": True}
+                        if seq is not None:
+                            ack["_rseq"] = seq
+                        queue_reply(ack, None)
+                        flush_replies()
                     finally:
                         # stop() even when the ack send fails: the reply
                         # cache would answer a resent shutdown without
@@ -290,10 +476,21 @@ class RpcServer:
                         self.stop()
                     return
                 if fault is not None and fault.action == "disconnect":
+                    # lose THIS reply only (see the drop branch): earlier
+                    # withheld replies flush before the conn severs
+                    flush_replies()
                     return  # applied, but the reply is lost; conn closed below
-                sent = send_frame(conn, rep, rep_arrays)
-                with self._counter_lock:
-                    self.bytes_out += sent
+                if seq is not None:
+                    # echo the request's sequence number so a pipelined
+                    # client matches this reply to the right in-flight
+                    # future (copy: rep may be a shared reply-cache dict)
+                    rep = {**rep, "_rseq": seq}
+                queue_reply(rep, rep_arrays)
+                # flush when input drains — or at a bound: withheld pull
+                # replies pin their row arrays, so a deep client window
+                # must not accumulate them without limit
+                if not reader.buffered() or out_frames >= 16:
+                    flush_replies()
         except (ConnectionError, OSError):
             return  # client went away; its requests died with it
         finally:
@@ -396,18 +593,43 @@ class RpcServer:
                 pass
 
 
+class _PendingCall:
+    """One in-flight request: everything needed to complete OR resend it."""
+
+    __slots__ = ("seq", "cmd", "header", "arrays", "future", "t0", "retry", "sent")
+
+    def __init__(
+        self, seq: Any, cmd: str, header: dict[str, Any],
+        arrays: Arrays | None, retry: bool,
+    ):
+        self.seq = seq
+        self.cmd = cmd
+        self.header = header
+        self.arrays = arrays
+        self.future: Future = Future()
+        self.t0 = time.perf_counter()
+        self.retry = retry
+        self.sent = False  # sent on the CURRENT connection generation
+
+
 class RpcClient:
-    """One persistent connection; requests are serialized under a lock
-    (the reference's per-remote-node send queue discipline).
+    """One persistent connection carrying a bounded window of pipelined
+    requests (ref: the per-remote-node send queue, now actually async).
+
+    ``call_async`` admits up to ``window`` seq-numbered requests onto the
+    wire without waiting for replies; a reader thread matches each reply
+    (by the server's ``_rseq`` echo) to its future. ``call`` is
+    ``call_async(...).result()`` — so concurrent callers overlap their
+    round trips instead of serializing a full RTT each.
 
     Self-healing: every request carries this client's id and a sequence
-    number. A mid-call ``OSError``/truncated frame triggers transparent
-    reconnect (exponential backoff + jitter, bounded by
-    ``reconnect_timeout_s``) and a resend of the SAME sequence number — the
-    server's reply cache makes the retry exactly-once even for
-    non-idempotent commands. The window only bounds time spent *retrying
-    after a failure*; a healthy blocking call (barrier, ssp_wait) may park
-    indefinitely as before."""
+    number. A dead connection triggers ONE heal (transparent reconnect
+    with exponential backoff + jitter, bounded by ``reconnect_timeout_s``)
+    that resends every pending request with its SAME sequence number — the
+    server's reply cache makes the resends exactly-once even for
+    non-idempotent commands, with the whole window in flight. The window
+    only bounds time spent *retrying after a failure*; a healthy blocking
+    call (barrier, ssp_wait) may park indefinitely as before."""
 
     def __init__(
         self,
@@ -417,6 +639,7 @@ class RpcClient:
         reconnect_timeout_s: float = 30.0,
         cid: str | None = None,
         start_seq: int = 0,
+        window: int = 8,
     ):
         """``cid``/``start_seq`` transfer a logical client identity into a
         rebuilt connection (ServerHandle recovery): the server's dedup
@@ -428,21 +651,32 @@ class RpcClient:
         self._cid = cid or uuid.uuid4().hex[:16]
         self._next_seq = start_seq
         self._reconnect_timeout_s = reconnect_timeout_s
+        self._window = max(1, int(window))
         self._rng = random.Random()  # backoff jitter: no determinism contract
-        self._lock = threading.Lock()
+        self._cv = threading.Condition()  # guards all connection/pending state
+        # serializes actual socket writes (inline fast path vs the writer
+        # thread) WITHOUT holding _cv: a send blocked on backpressure must
+        # never starve the reader completing replies
+        self._send_lock = threading.Lock()
+        self._pending: OrderedDict[Any, _PendingCall] = OrderedDict()
         self._closed = False
+        self._healing = False
+        self._gen = 0
+        self._sock: socket.socket | None = None
         self.bytes_out = 0
         self.bytes_in = 0
         last: Exception | None = None
         for _ in range(retries):
             try:
-                self._sock: socket.socket | None = self._connect()
+                sock = self._connect()
                 break
             except OSError as e:  # server may still be binding
                 last = e
                 time.sleep(retry_delay)
         else:
             raise ConnectionError(f"cannot reach {address}: {last}")
+        with self._cv:
+            self._install(sock)
 
     def _connect(self) -> socket.socket:
         host, port = self._address.rsplit(":", 1)
@@ -454,66 +688,116 @@ class RpcClient:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
-    def call(
-        self, cmd: str, arrays: Arrays | None = None, *, _retry: bool = True,
-        _seq: int | str | None = None, **fields: Any,
-    ) -> tuple[dict[str, Any], Arrays]:
-        """``_seq`` overrides the auto-allocated sequence number: a caller
-        that re-issues a logical request across *rebuilt* clients (e.g.
-        ``ServerHandle._keyed_call``) passes the same value each time so
-        every delivery is one dedup identity. Caller-owned seqs must live
-        in a disjoint namespace (the handle uses ``"k<n>"`` strings) so
-        they can never collide with the internal integer counter."""
-        with self._lock:
-            if _seq is None:
-                _seq = self._next_seq
-                self._next_seq += 1
-            header = {"cmd": cmd, "_cid": self._cid, "_seq": _seq, **fields}
-            t0 = time.perf_counter()
-            with trace.span(f"rpc.{cmd}", cat="rpc", addr=self._address):
-                # propagate this span's identity in the header so the
-                # server's dispatch span joins the same trace
-                ctx = trace.wire_context()
-                if ctx is not None:
-                    header["_trace"] = ctx
-                rep, rep_arrays = self._call_locked(header, arrays, _retry)
-            # client-observed latency: queueing + wire + service + any
-            # transparent retries/reconnects this call absorbed
-            latency_histograms.observe(
-                f"client.{cmd}", time.perf_counter() - t0
-            )
-        if not rep.get("ok", True):
-            raise RuntimeError(f"{cmd} failed remotely: {rep.get('error')}")
-        return rep, rep_arrays
+    def _install(self, sock: socket.socket) -> None:
+        """Adopt a connected socket (caller holds ``_cv``): bump the
+        connection generation and start the generation's reader and
+        writer threads."""
+        self._gen += 1
+        self._sock = sock
+        threading.Thread(
+            target=self._read_loop, args=(sock, self._gen), daemon=True
+        ).start()
+        threading.Thread(
+            target=self._write_loop, args=(sock, self._gen), daemon=True
+        ).start()
 
-    def _call_locked(
-        self, header: dict[str, Any], arrays: Arrays | None, retry: bool
-    ) -> tuple[dict[str, Any], Arrays]:
-        attempt = 0
-        deadline = time.monotonic() + self._reconnect_timeout_s
+    # -- completion side --------------------------------------------------
+
+    def _read_loop(self, sock: socket.socket, gen: int) -> None:
+        reader = FrameReader(sock)  # this thread owns the receive side
         while True:
             try:
-                if self._closed:
-                    raise ConnectionError(f"client to {self._address} is closed")
-                if self._sock is None:
-                    self._sock = self._connect()
-                    wire_counters.inc("rpc_reconnects")
-                    trace.instant(
-                        "rpc.reconnect", cat="rpc", addr=self._address
-                    )
-                self.bytes_out += send_frame(self._sock, header, arrays)
-                rep, rep_arrays, nbytes = recv_frame_sized(self._sock)
-                self.bytes_in += nbytes
-                return rep, rep_arrays
+                rep, arrays, nbytes = recv_frame_sized(reader)
             except (ConnectionError, OSError):
-                self._drop_sock()
-                if self._closed or not retry or time.monotonic() >= deadline:
-                    raise
-                wire_counters.inc("rpc_retries")
-                trace.instant(
-                    "rpc.retry", cat="rpc", addr=self._address,
-                    attempt=attempt,
+                break
+            p: _PendingCall | None = None
+            with self._cv:
+                if self._closed or self._gen != gen:
+                    return  # stale reader: a heal already replaced this conn
+                self.bytes_in += nbytes
+                seq = rep.pop("_rseq", None)
+                if seq is not None:
+                    p = self._pending.pop(seq, None)  # None: dup of a resend
+                elif self._pending:
+                    # reply without an echo (legacy server): per-connection
+                    # dispatch is serial and in order, the oldest wins
+                    _, p = self._pending.popitem(last=False)
+                self._cv.notify_all()  # window space freed
+            if p is not None:
+                self._complete(p, rep, arrays)
+        self._conn_died(sock, gen)
+
+    def _complete(self, p: _PendingCall, rep: dict[str, Any], arrays: Arrays) -> None:
+        # client-observed latency: queueing + wire + service + any
+        # transparent retries/reconnects this call absorbed
+        latency_histograms.observe(f"client.{p.cmd}", time.perf_counter() - p.t0)
+        if not rep.get("ok", True):
+            p.future.set_exception(
+                RuntimeError(f"{p.cmd} failed remotely: {rep.get('error')}")
+            )
+        else:
+            p.future.set_result((rep, arrays))
+
+    def _conn_died(self, sock: socket.socket, gen: int) -> None:
+        """A connection failed under its reader (or a sender): tear it
+        down and, when requests are stranded in flight, run the heal."""
+        heal = False
+        with self._cv:
+            if self._closed or self._gen != gen:
+                return
+            if self._sock is sock:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            if self._pending and not self._healing:
+                self._healing = True
+                heal = True
+            self._cv.notify_all()
+        if heal:
+            self._heal()
+
+    # -- healing ----------------------------------------------------------
+
+    def _heal(self) -> None:
+        """Reconnect and resend EVERY pending request under the same cid +
+        sequence numbers (the server's reply cache turns the at-least-once
+        resends into exactly-once applies, whole window included). Caller
+        owns ``self._healing``. On an exhausted window every pending
+        future fails with ConnectionError."""
+        wire_counters.inc("rpc_retries")
+        trace.instant("rpc.retry", cat="rpc", addr=self._address)
+        deadline = time.monotonic() + self._reconnect_timeout_s
+        attempt = 0
+        while True:
+            with self._cv:
+                closed = self._closed
+                # futures that opted out of retrying die with the conn
+                doomed = (
+                    [] if closed
+                    else [p for p in self._pending.values() if not p.retry]
                 )
+                for p in doomed:
+                    del self._pending[p.seq]
+            if closed:
+                self._abort_heal(
+                    ConnectionError(f"client to {self._address} is closed")
+                )
+                return
+            for p in doomed:
+                p.future.set_exception(
+                    ConnectionError(f"connection to {self._address} lost")
+                )
+            try:
+                sock = self._connect()
+            except OSError as e:
+                if time.monotonic() >= deadline:
+                    self._abort_heal(ConnectionError(
+                        f"server {self._address} unreachable for "
+                        f"{self._reconnect_timeout_s}s: {e}"
+                    ))
+                    return
                 # exponential backoff + jitter: a server resetting every
                 # connect must not be hammered at full speed, and lockstep
                 # clients must not reconnect in synchronized waves
@@ -521,29 +805,259 @@ class RpcClient:
                 delay *= 0.5 + self._rng.random()
                 time.sleep(min(delay, max(deadline - time.monotonic(), 0.0)))
                 attempt += 1
+                continue
+            with self._cv:
+                closed = self._closed
+                if not closed:
+                    self._install(sock)
+                    pend = list(self._pending.values())
+            if closed:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._abort_heal(
+                    ConnectionError(f"client to {self._address} is closed")
+                )
+                return
+            wire_counters.inc("rpc_reconnects")
+            trace.instant("rpc.reconnect", cat="rpc", addr=self._address)
+            try:
+                # one coalesced gather: the whole stranded window resends
+                # in a single write, same seqs (dedup makes it exactly-once)
+                bufs: list = []
+                total = 0
+                for p in pend:
+                    fb, n = build_frame(p.header, p.arrays)
+                    bufs.extend(fb)
+                    total += n
+                if bufs:
+                    _send_gather(sock, bufs)
+                with self._cv:
+                    self.bytes_out += total
+                    for p in pend:
+                        p.sent = True
+            except (ConnectionError, OSError):
+                # the replacement died mid-resend: drop it and retry
+                # within the same window (its reader sees a stale gen
+                # after the next install, or tears the sock down first)
+                with self._cv:
+                    if self._sock is sock:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                if time.monotonic() >= deadline:
+                    self._abort_heal(ConnectionError(
+                        f"server {self._address} kept resetting for "
+                        f"{self._reconnect_timeout_s}s"
+                    ))
+                    return
+                continue
+            with self._cv:
+                self._healing = False
+                self._cv.notify_all()
+            return
+
+    def _abort_heal(self, exc: Exception) -> None:
+        """Fail every pending future and release the heal. Futures complete
+        OUTSIDE the lock: a done-callback may issue a follow-up call on
+        this client, and ``_cv`` is not reentrant."""
+        with self._cv:
+            failed = list(self._pending.values())
+            self._pending.clear()
+            self._healing = False
+            self._cv.notify_all()
+        for p in failed:
+            if not p.future.done():
+                p.future.set_exception(exc)
+
+    # -- issue side -------------------------------------------------------
+
+    def call_async(
+        self, cmd: str, arrays: Arrays | None = None, *, _retry: bool = True,
+        _seq: int | str | None = None, _urgent: bool = False,
+        _inline: bool = False, **fields: Any,
+    ) -> Future:
+        """Issue one request without waiting for its reply; returns a
+        Future of ``(reply_header, reply_arrays)`` (failed remotely =>
+        RuntimeError, connection exhausted => ConnectionError).
+
+        ``_seq`` overrides the auto-allocated sequence number: a caller
+        that re-issues a logical request across *rebuilt* clients (e.g.
+        ``ServerHandle._keyed_call``) passes the same value each time so
+        every delivery is one dedup identity. Caller-owned seqs must live
+        in a disjoint namespace (the handle uses ``"k<n>"`` strings) so
+        they can never collide with the internal integer counter.
+
+        ``_urgent`` bypasses the window bound — ONLY for re-issues of an
+        already-admitted logical call (the need_keys bounce), which may
+        run on the reader thread and must never block on window space
+        that same thread is responsible for freeing."""
+        with trace.span(f"rpc.{cmd}", cat="rpc", addr=self._address):
+            # propagate this span's identity in the header so the server's
+            # dispatch span joins the same trace
+            ctx = trace.wire_context()
+            with self._cv:
+                if not _urgent:
+                    self._cv.wait_for(
+                        lambda: self._closed
+                        or len(self._pending) < self._window
+                    )
+                if self._closed:
+                    raise ConnectionError(
+                        f"client to {self._address} is closed"
+                    )
+                if _seq is None:
+                    _seq = self._next_seq
+                    self._next_seq += 1
+                header = {"cmd": cmd, "_cid": self._cid, "_seq": _seq, **fields}
+                if ctx is not None:
+                    header["_trace"] = ctx
+                p = _PendingCall(_seq, cmd, header, arrays, _retry)
+                self._pending[_seq] = p
+                wire_counters.observe_max(
+                    "rpc_inflight_peak", len(self._pending)
+                )
+                sock, gen = self._sock, self._gen
+                # fast path for LATENCY-bound callers (sync `call`): no
+                # unsent backlog and a live conn — claim and send inline,
+                # skipping the writer-thread handoff a lockstep caller
+                # would only pay latency for. THROUGHPUT-bound async
+                # callers skip it: their frames queue for the writer,
+                # whose batches coalesce into single gather writes (and
+                # arrive at the server as bursts its reply coalescing
+                # batches right back).
+                inline = (
+                    _inline
+                    and sock is not None
+                    and not self._healing
+                    and not any(
+                        q is not p and not q.sent and not q.future.done()
+                        for q in self._pending.values()
+                    )
+                )
+                if inline:
+                    p.sent = True
+                else:
+                    self._cv.notify_all()  # wake the connection's writer
+            if inline:
+                bufs, n = build_frame(p.header, p.arrays)
+                try:
+                    with self._send_lock:
+                        _send_gather(sock, bufs)
+                    with self._cv:
+                        self.bytes_out += n
+                except (ConnectionError, OSError):
+                    self._conn_died(sock, gen)  # heal resends the claim
+            else:
+                self._pump(p)
+        return p.future
+
+    def _pump(self, p: _PendingCall) -> None:
+        """After registering ``p``: make sure a connection exists for the
+        writer thread to carry it, healing (or failing fast for no-retry
+        callers) when the wire is down."""
+        while True:
+            with self._cv:
+                if p.future.done() or p.sent:
+                    return
+                if self._healing:
+                    self._cv.wait()  # the healer resends p for us
+                    continue
+                if self._sock is not None:
+                    return  # the connection's writer thread owns the send
+                if self._closed or not p.retry:
+                    self._pending.pop(p.seq, None)
+                    self._cv.notify_all()
+                    raise ConnectionError(
+                        f"client to {self._address} is "
+                        + ("closed" if self._closed else "disconnected")
+                    )
+                # connection down and nobody healing: this caller becomes
+                # the healer (fresh retry window)
+                self._healing = True
+            self._heal()
+
+    def _write_loop(self, sock: socket.socket, gen: int) -> None:
+        """The connection's writer: drain every unsent pending frame,
+        COALESCING each batch into one gather write. While a sendmsg
+        blocks on backpressure, new requests pile up in pending — so with
+        syscall-priced hosts and small frames a full window rides ONE
+        syscall, and the peer's FrameReader often picks the burst up in
+        one recv. Claims (``sent``) happen under the lock BEFORE the
+        write: a died connection hands everything to the heal, which
+        resends the whole pending map regardless of claims."""
+        while True:
+            with self._cv:
+                while True:
+                    if self._closed or self._gen != gen or self._sock is not sock:
+                        return
+                    if not self._healing:
+                        batch = [
+                            q for q in self._pending.values()
+                            if not q.sent and not q.future.done()
+                        ]
+                        if batch:
+                            break
+                    self._cv.wait()
+                for q in batch:
+                    q.sent = True  # claimed; heal ignores claims on resend
+            bufs: list = []
+            total = 0
+            for q in batch:
+                fb, n = build_frame(q.header, q.arrays)
+                bufs.extend(fb)
+                total += n
+            if len(batch) > 1:
+                wire_counters.inc("wire_frames_coalesced", len(batch) - 1)
+            try:
+                with self._send_lock:
+                    _send_gather(sock, bufs)
+            except (ConnectionError, OSError):
+                self._conn_died(sock, gen)  # heal resends the claimed batch
+                return
+            with self._cv:
+                self.bytes_out += total
+
+    def call(
+        self, cmd: str, arrays: Arrays | None = None, *, _retry: bool = True,
+        _seq: int | str | None = None, **fields: Any,
+    ) -> tuple[dict[str, Any], Arrays]:
+        """Synchronous round trip: ``call_async(...).result()`` on the
+        latency fast path. Concurrent callers pipeline on the shared
+        window instead of serializing."""
+        fut = self.call_async(
+            cmd, arrays, _retry=_retry, _seq=_seq, _inline=True, **fields
+        )
+        return fut.result()
 
     @property
     def identity(self) -> tuple[str, int]:
         """(cid, next unused internal seq) — transfer into a replacement
         client (``RpcClient(..., cid=, start_seq=)``) so the server's
         dedup state keeps recognizing the logical caller across rebuilds."""
-        return self._cid, self._next_seq
-
-    def _drop_sock(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        with self._cv:
+            return self._cid, self._next_seq
 
     def close(self) -> None:
-        self._closed = True  # no reconnects on behalf of a closed client
-        if self._sock is not None:
+        with self._cv:
+            self._closed = True  # no reconnects on behalf of a closed client
+            sock, self._sock = self._sock, None
+            failed = list(self._pending.values())
+            self._pending.clear()
+            self._cv.notify_all()
+        if sock is not None:
             try:
-                self._sock.close()
+                sock.close()
             except OSError:
                 pass
+        for p in failed:
+            if not p.future.done():
+                p.future.set_exception(
+                    ConnectionError(f"client to {self._address} is closed")
+                )
 
 
 class Coordinator:
@@ -591,6 +1105,7 @@ class Coordinator:
                 "progress_merged", "workload_stats", "ssp_progress",
                 "telemetry",
             }),
+            blocking_cmds=frozenset({"barrier", "ssp_wait", "kv_get"}),
         )
         self.server.start()
         self.address = self.server.address

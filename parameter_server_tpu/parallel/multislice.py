@@ -29,8 +29,8 @@ import hashlib
 import json
 import threading
 import time
-from collections import OrderedDict, deque
-from concurrent.futures import ThreadPoolExecutor
+from collections import OrderedDict
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any
 
 import numpy as np
@@ -49,15 +49,6 @@ from parameter_server_tpu.utils.config import PSConfig
 from parameter_server_tpu.utils.heartbeat import HeartbeatReporter, host_stats
 from parameter_server_tpu.utils.keyrange import KeyRange
 from parameter_server_tpu.utils.metrics import telemetry_snapshot, wire_counters
-
-
-def _with_trace_ctx(ctx, fn, *args):
-    """Run ``fn`` on a pool thread under a captured trace context: thread
-    locals don't cross ThreadPoolExecutor, so the step span's identity
-    must be carried over explicitly or the per-server ps.pull/ps.push
-    spans would each start an unrelated trace."""
-    with trace.activate(ctx):
-        return fn(*args)
 
 
 def _plan_from_cfg(cfg: PSConfig) -> FaultPlan | None:
@@ -433,7 +424,11 @@ class ServerHandle:
         # a genuinely moved server falls through to the resolver loop in
         # _keyed_call quickly instead of burning the whole handle window
         self._client_window_s = min(3.0, self._reconnect_timeout_s)
-        self.client = RpcClient(address, reconnect_timeout_s=self._client_window_s)
+        self._pipeline_window = max(1, cfg.wire.window)
+        self.client = RpcClient(
+            address, reconnect_timeout_s=self._client_window_s,
+            window=self._pipeline_window,
+        )
         # a worker's pull and in-flight push threads share this handle;
         # concurrent failures must rebuild the connection once — the
         # generation counter lets a late-arriving failing thread see that
@@ -458,17 +453,27 @@ class ServerHandle:
         # constant across client rebuilds so every delivery of a logical
         # push is one dedup identity on the server
         self._kseq = itertools.count()
+        # lazy single-thread executor for the RESOLVER retry path of async
+        # calls: a reader thread completing a failed future must never run
+        # the blocking reconnect loop itself
+        self._recovery_pool: ThreadPoolExecutor | None = None
         if self._codec_bytes:
             from parameter_server_tpu.filters.fixed_point import FixedPointCodec
 
             self._codec = FixedPointCodec(num_bytes=self._codec_bytes)
 
-    def _keyed_call(self, cmd: str, keys: np.ndarray, arrays: Arrays, **fields):
+    def _keyed_call(
+        self, cmd: str, keys: np.ndarray, arrays: Arrays,
+        lseq: str | None = None, **fields,
+    ):
         """Issue a keyed request, sending the key list only when the server
         doesn't hold it (key-caching filter, worker side). A lost
         connection triggers reconnect-and-retry against the (possibly
-        relaunched) server when a resolver was provided."""
-        lseq = f"k{next(self._kseq)}"
+        relaunched) server when a resolver was provided. ``lseq`` re-enters
+        a logical call that already holds a dedup identity (the async
+        recovery path); fresh calls allocate their own."""
+        if lseq is None:
+            lseq = f"k{next(self._kseq)}"
         gen = self._conn_gen
         try:
             return self._keyed_call_once(cmd, keys, arrays, lseq, **fields)
@@ -527,6 +532,7 @@ class ServerHandle:
                         addr, retries=1,
                         reconnect_timeout_s=self._client_window_s,
                         cid=cid, start_seq=next_seq,
+                        window=self._pipeline_window,
                     )
                     self._sent_sigs = _LruSigs()
                     self._conn_gen += 1
@@ -563,6 +569,191 @@ class ServerHandle:
         self._sent_sigs.put(sig)
         return rep, out
 
+    # -- async (pipelined) issue path -------------------------------------
+
+    def _keyed_call_async(
+        self, cmd: str, keys: np.ndarray, arrays: Arrays, **fields
+    ):
+        """Async twin of ``_keyed_call``: issues the request onto the
+        client's pipelined window and returns a Future of (rep, arrays).
+        The need_keys bounce re-issues with the SAME "k<n>" seq from the
+        completion callback (``_urgent``: a reader thread must not block
+        on window space it is responsible for freeing), and a connection
+        that outlives the client's own heal window falls back to the
+        blocking resolver retry loop on the handle's recovery thread."""
+        outer: Future = Future()
+        lseq = f"k{next(self._kseq)}"
+        sig = _sig(keys)
+        send_keys = not (self._key_caching and sig in self._sent_sigs)
+        payload = dict(arrays)
+        if send_keys:
+            payload["keys"] = keys.astype(self._key_dtype)
+
+        def on_reply(f, bounced: bool = False) -> None:
+            # NOTHING may escape this callback: concurrent.futures logs
+            # and swallows done-callback exceptions, which would leave
+            # ``outer`` unresolved and its waiter parked forever — every
+            # failure (including a shut-down recovery pool or a closed
+            # client on the bounce re-issue) must land in ``outer``
+            try:
+                try:
+                    rep, out = f.result()
+                except (ConnectionError, BrokenPipeError, OSError):
+                    if self._resolve_addr is None:
+                        raise
+                    # server moved or kept resetting past the client's
+                    # heal: run the blocking resolver loop OFF this
+                    # (reader) thread, same lseq so every delivery stays
+                    # one dedup identity
+                    self._recovery().submit(
+                        self._recover_async, cmd, keys, arrays, lseq,
+                        fields, outer,
+                    )
+                    return
+                if rep.get("need_keys"):
+                    if bounced:  # keys were in the frame: a repeat is a bug
+                        raise RuntimeError(
+                            f"server rank {self.rank} bounced a keyed {cmd}"
+                        )
+                    p2 = dict(arrays)
+                    p2["keys"] = keys.astype(self._key_dtype)
+                    f2 = self.client.call_async(
+                        cmd, arrays=p2, worker=self.worker, sig=sig,
+                        zip=self._zip, _seq=lseq, _urgent=True, **fields,
+                    )
+                    f2.add_done_callback(lambda g: on_reply(g, bounced=True))
+                    return
+                self._sent_sigs.put(sig)
+                outer.set_result((rep, out))
+            except BaseException as e:  # noqa: BLE001 — future boundary
+                if not outer.done():
+                    outer.set_exception(e)
+
+        try:
+            f1 = self.client.call_async(
+                cmd, arrays=payload, worker=self.worker, sig=sig,
+                zip=self._zip, _seq=lseq, **fields,
+            )
+        except (ConnectionError, BrokenPipeError, OSError) as e:
+            if self._resolve_addr is None:
+                raise
+            self._recovery().submit(
+                self._recover_async, cmd, keys, arrays, lseq, fields, outer
+            )
+            return outer
+        f1.add_done_callback(on_reply)
+        return outer
+
+    def _recover_async(
+        self, cmd, keys, arrays, lseq, fields, outer
+    ) -> None:
+        """Recovery-thread tail of a failed async call: the synchronous
+        resolver retry loop, completing the caller's outer future."""
+        try:
+            outer.set_result(
+                self._keyed_call(cmd, keys, arrays, lseq=lseq, **fields)
+            )
+        except BaseException as e:  # noqa: BLE001 — future boundary
+            outer.set_exception(e)
+
+    def _recovery(self) -> ThreadPoolExecutor:
+        with self._reconnect_lock:
+            if self._recovery_pool is None:
+                self._recovery_pool = ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix=f"ps-recover-{self.rank}",
+                )
+            return self._recovery_pool
+
+    def pull_async(self, local_keys: np.ndarray):
+        """Issue a pull without blocking; Future of the float32 rows. Flow
+        events link the issue span to the completion across the window."""
+        out_f: Future = Future()
+        if len(local_keys) == 0:
+            out_f.set_result(np.zeros(0, dtype=np.float32))
+            return out_f
+        with trace.span(
+            "ps.pull", cat="ps", rank=self.rank, keys=len(local_keys)
+        ):
+            flow = trace.flow_start("ps.pull.inflight", cat="ps")
+            ctx = trace.wire_context()
+            inner = self._keyed_call_async("pull", local_keys, {})
+
+        def done(f) -> None:
+            # nothing may escape (see _keyed_call_async.on_reply): a
+            # swallowed callback error would leave out_f unresolved and
+            # its waiter parked forever
+            try:
+                with trace.activate(ctx):
+                    trace.flow_end(
+                        "ps.pull.inflight", cat="ps", flow_id=flow
+                    )
+                _, out = f.result()
+                out_f.set_result(out["w"].astype(np.float32))
+            except BaseException as e:  # noqa: BLE001 — future boundary
+                if not out_f.done():
+                    out_f.set_exception(e)
+
+        inner.add_done_callback(done)
+        return out_f
+
+    def push_async(self, local_keys: np.ndarray, grads: np.ndarray):
+        """Issue a push without blocking; the Future resolves (to None)
+        once the server acked the apply — the worker's PushWindow hangs
+        ssp_finish off that. A flow event pair links the issue span to
+        the completion event so Perfetto draws the in-flight arrow."""
+        done_f: Future = Future()
+        if len(local_keys) == 0:
+            done_f.set_result(None)
+            return done_f
+        fields, arrays = self._encode_push(grads)
+        with trace.span(
+            "ps.push", cat="ps", rank=self.rank, keys=len(local_keys),
+            bytes=int(sum(a.nbytes for a in arrays.values())),
+        ):
+            flow = trace.flow_start("ps.push.inflight", cat="ps")
+            ctx = trace.wire_context()
+            inner = self._keyed_call_async(
+                "push", local_keys, arrays, **fields
+            )
+
+        def done(f) -> None:
+            # nothing may escape (see _keyed_call_async.on_reply)
+            try:
+                with trace.activate(ctx):
+                    trace.flow_end(
+                        "ps.push.inflight", cat="ps", flow_id=flow
+                    )
+                f.result()
+                done_f.set_result(None)
+            except BaseException as e:  # noqa: BLE001 — future boundary
+                if not done_f.done():
+                    done_f.set_exception(e)
+
+        inner.add_done_callback(done)
+        return done_f
+
+    def _encode_push(self, grads: np.ndarray) -> tuple[dict[str, Any], Arrays]:
+        """Apply the send filters to one push payload (shared by the sync
+        and async paths): optional fixed-point quantization, else f32."""
+        fields: dict[str, Any] = {"codec": 0}
+        if self._codec_bytes:
+            import jax
+
+            e = self._codec.encode(
+                jax.random.key(next(self._quant_seed)),
+                grads.astype(np.float32),
+            )
+            arrays: Arrays = {
+                "q": np.asarray(e.q),
+                "lo": np.asarray(e.lo)[None],
+                "scale": np.asarray(e.scale)[None],
+            }
+            fields["codec"] = self._codec_bytes
+        else:
+            arrays = {"g": grads.astype(np.float32)}
+        return fields, arrays
+
     def pull(self, local_keys: np.ndarray) -> np.ndarray:
         if len(local_keys) == 0:
             return np.zeros(0, dtype=np.float32)
@@ -576,23 +767,7 @@ class ServerHandle:
     def push(self, local_keys: np.ndarray, grads: np.ndarray) -> None:
         if len(local_keys) == 0:
             return
-        fields: dict[str, Any] = {"codec": 0}
-        arrays: Arrays = {}
-        if self._codec_bytes:
-            import jax
-
-            e = self._codec.encode(
-                jax.random.key(next(self._quant_seed)),
-                grads.astype(np.float32),
-            )
-            arrays = {
-                "q": np.asarray(e.q),
-                "lo": np.asarray(e.lo)[None],
-                "scale": np.asarray(e.scale)[None],
-            }
-            fields["codec"] = self._codec_bytes
-        else:
-            arrays = {"g": grads.astype(np.float32)}
+        fields, arrays = self._encode_push(grads)
         with trace.span(
             "ps.push", cat="ps", rank=self.rank, keys=len(local_keys),
             bytes=int(sum(a.nbytes for a in arrays.values())),
@@ -612,6 +787,8 @@ class ServerHandle:
 
     def close(self) -> None:
         self.client.close()
+        if self._recovery_pool is not None:
+            self._recovery_pool.shutdown(wait=False)
 
 
 # ---------------------------------------------------------------------------
@@ -792,21 +969,20 @@ def run_worker(
         g = csr_grad(err, values, local_ids, row_ids, num_unique=w_u.shape[0])
         return loss, jax.nn.sigmoid(logits), g
 
-    pool = ThreadPoolExecutor(max_workers=max(num_servers, 1))
-    pending: deque[tuple[int, list]] = deque()  # in-flight pushes per step
-    max_delay = cfg.solver.max_delay
-    inflight_limit = max_delay if max_delay >= 0 else (1 << 30)
+    from parameter_server_tpu.parallel.ssp import PushWindow
 
-    def drain(limit: int) -> None:
-        """Retire finished pushes; enforce the in-flight bound (ref: the
-        worker Executor blocking when the wait_time dependency is unmet)."""
-        while pending and (
-            len(pending) > limit or all(f.done() for f in pending[0][1])
-        ):
-            step_i, futs = pending.popleft()
-            for f in futs:
-                f.result()  # surface push errors
-            ctl.ssp_finish(rank, step_i)
+    # in-flight push bound, in whole steps: the SSP delay shapes it (a step
+    # only ssp_finishes when its pushes applied, so more than tau+1 steps
+    # in flight could never clear the gate anyway), and the explicit
+    # wire.max_inflight_pushes knob tightens it when wire memory — not
+    # staleness — is the binding constraint
+    max_delay = cfg.solver.max_delay
+    ssp_limit = max_delay if max_delay >= 0 else (1 << 30)
+    cap = cfg.wire.max_inflight_pushes
+    inflight_limit = ssp_limit if cap <= 0 else min(ssp_limit, cap)
+    pushes = PushWindow(
+        inflight_limit, retire=lambda step_i: ctl.ssp_finish(rank, step_i)
+    )
 
     step = 0
     window: list[tuple[float, np.ndarray, np.ndarray]] = []
@@ -837,6 +1013,11 @@ def run_worker(
                 # data-plane traffic are all in
                 "wire_bytes_out": wire_counters.get("wire_bytes_out"),
                 "wire_bytes_in": wire_counters.get("wire_bytes_in"),
+                # adaptive-compression accounting (the per-filter byte
+                # counters the reference's Postoffice kept): bytes the
+                # codec won, and probes that declined incompressible data
+                "wire_bytes_saved": wire_counters.get("wire_bytes_saved"),
+                "wire_comp_skipped": wire_counters.get("wire_comp_skipped"),
                 # self-healing counters, cumulative for this worker process
                 # (merged at the scheduler as cluster totals)
                 "rpc_retries": wire_counters.get("rpc_retries"),
@@ -863,14 +1044,13 @@ def run_worker(
             # retire our own in-flight pushes first: the clock's gate for
             # step t includes this worker's finished counter (wait_time
             # semantics), so draining after the gate would self-deadlock
-            drain(inflight_limit)
+            pushes.gate()
             # step anatomy (the "where did this step's 40 ms go" spans):
             # one enclosing step span; ssp_wait / pull / compute are its
-            # children, and its context is carried onto the pool threads
-            # so the per-server ps.pull / in-flight ps.push RPC chains
-            # join the SAME trace instead of starting their own
+            # children. Pull and push fan out over every shard server
+            # CONCURRENTLY on the pipelined async wire — no thread pool;
+            # flow events tie each push's issue span to its completion.
             with trace.span("step", cat="step", step=step):
-                step_ctx = trace.wire_context()
                 with trace.span("step.ssp_wait", cat="step"):
                     ctl.ssp_wait(rank, step)
                 # slice the batch's (sorted) unique keys against ranges
@@ -882,15 +1062,10 @@ def run_worker(
                     for s in range(num_servers)
                 ]
                 with trace.span("step.pull", cat="step"):
-                    pull_ctx = trace.wire_context()
-                    pulls = list(
-                        pool.map(
-                            lambda sh_seg: _with_trace_ctx(
-                                pull_ctx, sh_seg[0].pull, sh_seg[1]
-                            ),
-                            zip(servers, segs),
-                        )
-                    )
+                    pull_futs = [
+                        sh.pull_async(seg) for sh, seg in zip(servers, segs)
+                    ]
+                    pulls = [f.result() for f in pull_futs]
                 with trace.span("step.compute", cat="step"):
                     w_u = np.zeros(len(b.unique_keys), dtype=np.float32)
                     w_u[1 : b.num_unique] = (
@@ -901,16 +1076,15 @@ def run_worker(
                         b.example_mask,
                     )
                     g_real = np.asarray(g).ravel()[1 : b.num_unique]
-                # pushes ride the thread pool past this span's exit; the
-                # captured step context still parents their ps.push chains
+                # pushes stay in flight past this span's exit; the flow
+                # links (ps.push.inflight) bridge issue to completion
                 futs = [
-                    pool.submit(
-                        _with_trace_ctx, step_ctx, servers[s].push,
-                        segs[s], g_real[bounds[s] : bounds[s + 1]],
+                    servers[s].push_async(
+                        segs[s], g_real[bounds[s] : bounds[s + 1]]
                     )
                     for s in range(num_servers)
                 ]
-            pending.append((step, futs))
+            pushes.add(step, futs)
             ex_seen += b.num_examples
             window.append(
                 (
@@ -923,7 +1097,7 @@ def run_worker(
                 flush_window()
             step += 1
         ctl.workload_finish(workload)
-    drain(0)
+    pushes.wait_all()  # the sync point: every in-flight push acked
     flush_window()
     ctl.ssp_retire(rank)  # out of data: stop gating the still-running workers
     # completion signal (replaces a fixed barrier: a barrier over

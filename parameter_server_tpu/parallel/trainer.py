@@ -628,7 +628,7 @@ class PodTrainer:
                     gate.drain()
                     last = self._flush(window, n_since, t0)
                     window, n_since, t0 = [], 0, time.perf_counter()
-            gate.drain()
+            gate.wait_all()  # epoch sync point: every dispatched step retired
         finally:
             if pipeline is not None:
                 pipeline.close()

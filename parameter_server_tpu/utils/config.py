@@ -176,6 +176,20 @@ class FilterConfig:
 
 
 @dataclass
+class WireConfig:
+    """Async pipelined RPC data plane (parallel/control.py): the wire-tier
+    analog of the reference's bounded per-connection send window."""
+
+    # in-flight seq-numbered requests per RpcClient connection; 1 restores
+    # the old lockstep request-reply discipline
+    window: int = 8
+    # bound on whole STEPS of in-flight pushes a wire-tier worker may hold
+    # before blocking (run_worker's PushWindow); 0 derives the bound purely
+    # from solver.max_delay, so SSP semantics alone shape the window
+    max_inflight_pushes: int = 0
+
+
+@dataclass
 class ParallelConfig:
     """Mesh topology: the TPU analog of -num_servers / -num_workers."""
 
@@ -244,6 +258,7 @@ class PSConfig:
     w2v: W2VConfig = field(default_factory=W2VConfig)
     wd: WDConfig = field(default_factory=WDConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    wire: WireConfig = field(default_factory=WireConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     model_output: str = ""
@@ -286,6 +301,7 @@ _NESTED = {
     "w2v": W2VConfig,
     "wd": WDConfig,
     "parallel": ParallelConfig,
+    "wire": WireConfig,
     "fault": FaultConfig,
     "trace": TraceConfig,
 }

@@ -36,6 +36,13 @@ class CounterSet:
         with self._lock:
             self._d[name] = self._d.get(name, 0) + n
 
+    def observe_max(self, name: str, v: int) -> None:
+        """High-watermark counter (e.g. ``rpc_inflight_peak``: the deepest
+        pipelined request window any connection actually reached)."""
+        with self._lock:
+            if v > self._d.get(name, 0):
+                self._d[name] = v
+
     def get(self, name: str) -> int:
         with self._lock:
             return self._d.get(name, 0)
@@ -247,13 +254,18 @@ def telemetry_snapshot() -> dict[str, Any]:
 
 def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
     """Cluster merge of telemetry snapshots: counters and timers sum,
-    histograms merge bucket-wise (exact — no quantile averaging)."""
+    histograms merge bucket-wise (exact — no quantile averaging).
+    High-watermark gauges (``*_peak``, fed by ``observe_max``) merge as a
+    max — summing per-node peaks would report a depth nothing reached."""
     counters: dict[str, int] = {}
     hists: dict[str, list[dict]] = {}
     tmr: dict[str, dict[str, float]] = {}
     for s in snaps:
         for k, v in s.get("counters", {}).items():
-            counters[k] = counters.get(k, 0) + v
+            if k.endswith("_peak"):
+                counters[k] = max(counters.get(k, 0), v)
+            else:
+                counters[k] = counters.get(k, 0) + v
         for k, v in s.get("hists", {}).items():
             hists.setdefault(k, []).append(v)
         for k, v in s.get("timers", {}).items():
@@ -289,7 +301,8 @@ def format_cluster_stats(rep: dict[str, Any]) -> str:
     counters), then the merged per-command latency table."""
     lines = [
         f"{'node':>5} {'role':<10} {'rank':>5} {'rss_mb':>8} "
-        f"{'wire_out':>12} {'wire_in':>12} {'retries':>8} {'dedup':>6}"
+        f"{'wire_out':>12} {'wire_in':>12} {'saved':>10} "
+        f"{'retries':>8} {'dedup':>6}"
     ]
     for nid in sorted(rep.get("nodes", {}), key=lambda x: int(x)):
         n = rep["nodes"][nid]
@@ -301,6 +314,7 @@ def format_cluster_stats(rep: dict[str, Any]) -> str:
             f"{stats.get('max_rss_mb', float('nan')):>8.1f} "
             f"{ctr.get('wire_bytes_out', 0):>12} "
             f"{ctr.get('wire_bytes_in', 0):>12} "
+            f"{ctr.get('wire_bytes_saved', 0):>10} "
             f"{ctr.get('rpc_retries', 0):>8} "
             f"{ctr.get('rpc_dedup_hits', 0):>6}"
         )
@@ -396,6 +410,8 @@ def merge_progress(reports: list[dict[str, Any]]) -> dict[str, Any]:
         "bytes_pulled",
         "wire_bytes_out",
         "wire_bytes_in",
+        "wire_bytes_saved",
+        "wire_comp_skipped",
         "est_collective_bytes",
         # self-healing control plane (each worker reports its cumulative
         # wire_counters; the merge is the cluster total)

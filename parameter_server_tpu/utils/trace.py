@@ -241,6 +241,53 @@ class Tracer:
             "args": args,
         })
 
+    def flow_start(
+        self, name: str, cat: str = "", flow_id: str | None = None,
+        **args: Any,
+    ) -> str | None:
+        """Open a flow arrow (Chrome ``"s"`` event): the span-link
+        primitive for in-flight futures — a later :meth:`flow_end` with
+        the same id (on any thread or span) draws the arrow from this
+        point to that one in Perfetto, linking a push's issue span to its
+        completion. Returns the flow id (None when disabled — callers
+        pass it straight back to ``flow_end``, which then no-ops)."""
+        if self._dir is None:
+            return None
+        fid = flow_id or _new_id()
+        self._record_flow(name, cat, "s", fid, args)
+        return fid
+
+    def flow_end(
+        self, name: str, cat: str = "", flow_id: str | None = None,
+        **args: Any,
+    ) -> None:
+        """Close a flow arrow opened by ``flow_start`` (Chrome ``"f"``
+        event, next-slice binding). No-op when disabled or fed the None
+        id a disabled ``flow_start`` returned."""
+        if self._dir is None or flow_id is None:
+            return
+        self._record_flow(name, cat, "f", flow_id, args)
+
+    def _record_flow(
+        self, name: str, cat: str, ph: str, fid: str, args: dict[str, Any]
+    ) -> None:
+        cur = getattr(_current, "span", None)
+        if cur is not None and cur.trace_id is not None:
+            args = {"trace_id": cur.trace_id, "parent_id": cur.span_id, **args}
+        ev: dict[str, Any] = {
+            "name": name,
+            "cat": cat or "default",
+            "ph": ph,
+            "id": fid,
+            "ts": _now_us(),
+            "pid": os.getpid(),
+            "tid": threading.get_native_id(),
+            "args": args,
+        }
+        if ph == "f":
+            ev["bp"] = "e"  # bind to the enclosing slice at the arrowhead
+        self._record(ev)
+
     def wire_context(self) -> dict[str, str] | None:
         """The current span's identity for an RPC header (``None`` when
         disabled or outside any span — callers skip the header field)."""
@@ -353,6 +400,18 @@ def span(name: str, cat: str = "", **args: Any):
 
 def instant(name: str, cat: str = "", **args: Any) -> None:
     tracer.instant(name, cat, **args)
+
+
+def flow_start(
+    name: str, cat: str = "", flow_id: str | None = None, **args: Any
+) -> str | None:
+    return tracer.flow_start(name, cat, flow_id, **args)
+
+
+def flow_end(
+    name: str, cat: str = "", flow_id: str | None = None, **args: Any
+) -> None:
+    tracer.flow_end(name, cat, flow_id, **args)
 
 
 def wire_context() -> dict[str, str] | None:

@@ -32,7 +32,15 @@ def _full(sub_overrides=None, **top):
                    "parse_build_ex_per_sec": 6e5},
         "wire_rpc": {"roundtrips_per_sec": 1200.0, "pull_p50_ms": 0.512,
                      "pull_p99_ms": 2.048, "push_p50_ms": 0.512,
-                     "push_p99_ms": 4.096},
+                     "push_p99_ms": 4.096,
+                     "push_rps_lockstep": 900.0,
+                     "push_rps_pipelined_w8": 2700.0,
+                     "pipelined_speedup_w8": 3.0,
+                     "mb_s_1mib_pipelined": 850.0,
+                     "sweep": {"4KiB": {"lockstep_mb_s": 3.5,
+                                        "pipelined_mb_s": 12.0,
+                                        "speedup": 3.4}},
+                     "wire_bytes_saved": 41000000},
     }
     sub.update(sub_overrides or {})
     return {
@@ -62,13 +70,22 @@ class TestCompactContract:
 
     def test_telemetry_block_reaches_the_line(self):
         c = bench._compact_contract(_full(), "f.json")
-        # the telemetry plane's RPC latency must ride the driver-recorded
-        # stdout line, not just the full results file
+        # the telemetry plane's RPC latency AND the pipelined wire's
+        # headline ratios must ride the driver-recorded stdout line, not
+        # just the full results file
         assert c["sub"]["rpc"] == {
             "roundtrips_per_sec": 1200.0,
             "pull_p50_ms": 0.512,
             "push_p99_ms": 4.096,
+            "pipelined_speedup_w8": 3.0,
+            "mb_s_1mib_pipelined": 850.0,
         }
+
+    def test_line_still_fits_with_pipelined_fields(self):
+        line = json.dumps(bench._compact_contract(_full(), "f.json"))
+        assert len(line) < 1500
+        c = json.loads(line)
+        assert c["sub"]["rpc"]["pipelined_speedup_w8"] == 3.0
 
     def test_wire_rpc_error_still_fits_and_is_marked(self):
         full = _full(sub_overrides={"wire_rpc": {"error": "boom " * 100}})

@@ -1,0 +1,495 @@
+"""Async pipelined push/pull engine + zero-copy wire path (fast tier-1).
+
+Covers the ISSUE 3 tentpole: zero-copy framing (gather writes, per-array
+adaptive compression, view-not-copy receive), the windowed pipelined
+``RpcClient`` (seq-echo matched futures, bounded window, exactly-once under
+chaos with W>1 in flight), the key-cache ``need_keys`` bounce landing
+mid-window without corrupting neighbouring replies, ``_LruSigs`` eviction,
+and the worker-side ``PushWindow`` bounded-delay/wait_all semantics.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+import zlib
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.parallel.chaos import FaultPlan
+from parameter_server_tpu.parallel.control import (
+    _COMP_MIN_BYTES,
+    FrameReader,
+    RpcClient,
+    RpcServer,
+    recv_frame,
+    send_frame,
+)
+from parameter_server_tpu.parallel.multislice import _LruSigs
+from parameter_server_tpu.parallel.ssp import PushWindow
+from parameter_server_tpu.utils.metrics import wire_counters
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    wire_counters.reset()
+    yield
+    wire_counters.reset()
+
+
+class _GatherSink:
+    """Captures gather writes (sendmsg) like a socket; used to inspect the
+    exact bytes/buffers a frame puts on the wire."""
+
+    def __init__(self):
+        self.chunks: list[bytes] = []
+        self.gathers = 0
+
+    def sendmsg(self, buffers):
+        self.gathers += 1
+        n = 0
+        for b in buffers:
+            bb = bytes(b)
+            self.chunks.append(bb)
+            n += len(bb)
+        return n
+
+    def frame_bytes(self) -> bytes:
+        return b"".join(self.chunks)
+
+
+def _parse_frame(raw: bytes):
+    import json
+
+    hlen, plen = struct.unpack("<II", raw[:8])
+    header = json.loads(raw[8 : 8 + hlen])
+    return header, raw[8 + hlen : 8 + hlen + plen]
+
+
+class TestZeroCopyFraming:
+    def test_recv_lands_payload_as_view_not_copy(self, rng):
+        a, b = socket.socketpair()
+        try:
+            x = rng.normal(size=2048).astype(np.float32)
+            send_frame(a, {"cmd": "x"}, {"x": x})
+            _, out = recv_frame(b)
+            np.testing.assert_array_equal(out["x"], x)
+            # zero-copy landing: the array VIEWS the receive buffer (a
+            # frombuffer over the preallocated bytearray, not a bytes copy)
+            assert not out["x"].flags.owndata
+        finally:
+            a.close()
+            b.close()
+
+    def test_gather_write_no_concat(self, rng):
+        sink = _GatherSink()
+        x = rng.normal(size=4096).astype(np.float32)
+        keys = np.arange(100, dtype=np.uint32)
+        send_frame(sink, {"cmd": "x"}, {"keys": keys, "g": x})
+        # one gather, multiple buffers: len-word + header + one per array
+        assert sink.gathers == 1
+        assert len(sink.chunks) >= 4
+        assert wire_counters.get("wire_frames_zero_copy") == 1
+
+    def test_adaptive_compression_per_array(self, rng):
+        """zip=True: compressible float arrays shrink, integer key lists
+        and quantized int8 payloads stay raw, random float32 is DECLINED
+        by the probe (zlib would cost CPU for ~0% savings)."""
+        sink = _GatherSink()
+        arrays = {
+            "zeros": np.zeros(65536, np.float32),  # compressible, big
+            "rand": rng.normal(size=65536).astype(np.float32),  # incompressible
+            "keys": np.arange(65536, dtype=np.uint32),  # integer: never
+            "q": np.ones(65536, np.int8),  # quantized: never
+            "tiny": np.zeros(8, np.float32),  # under the floor
+        }
+        send_frame(sink, {"cmd": "x", "zip": True}, arrays)
+        header, _ = _parse_frame(sink.frame_bytes())
+        clen = {m[0]: m[3] for m in header["arrays"]}
+        assert clen["zeros"] > 0  # compressed
+        assert clen["rand"] == 0  # probe declined
+        assert clen["keys"] == 0 and clen["q"] == 0 and clen["tiny"] == 0
+        assert wire_counters.get("wire_bytes_saved") > 200000
+        assert wire_counters.get("wire_comp_skipped") >= 1
+
+    def test_compressed_roundtrip_mixed(self, rng):
+        a, b = socket.socketpair()
+        try:
+            arrays = {
+                "z": np.zeros(30000, np.float32),
+                "r": rng.normal(size=3000).astype(np.float32),
+                "k": np.arange(500, dtype=np.uint64),
+            }
+            send_frame(a, {"cmd": "x", "zip": True}, arrays)
+            h, out = recv_frame(b)
+            for k, v in arrays.items():
+                np.testing.assert_array_equal(out[k], v)
+                assert out[k].dtype == v.dtype
+        finally:
+            a.close()
+            b.close()
+
+    def test_no_zip_never_compresses(self):
+        sink = _GatherSink()
+        send_frame(sink, {"cmd": "x"}, {"z": np.zeros(65536, np.float32)})
+        header, payload = _parse_frame(sink.frame_bytes())
+        assert header["arrays"][0][3] == 0
+        assert len(payload) == 65536 * 4
+        assert wire_counters.get("wire_bytes_saved") == 0
+
+    def test_comp_floor_is_sane(self):
+        # guards against someone lowering the floor into per-array noise
+        assert _COMP_MIN_BYTES >= 256
+
+    def test_frame_reader_buffers_and_big_reads(self, rng):
+        a, b = socket.socketpair()
+        try:
+            small = {"s": np.arange(16, dtype=np.int32)}
+            big = {"g": rng.normal(size=1 << 16).astype(np.float32)}  # 256K
+            # feed from a thread: the big frame exceeds the socketpair's
+            # kernel buffer, so an unread send would park forever
+            def feed():
+                for arrays in (small, small, big, small):
+                    send_frame(a, {"cmd": "x"}, arrays)
+
+            threading.Thread(target=feed, daemon=True).start()
+            reader = FrameReader(b, cap=4096)  # smaller than the big frame
+            from parameter_server_tpu.parallel.control import recv_frame_sized
+
+            for arrays in (small, small, big, small):
+                _, out, _ = recv_frame_sized(reader)
+                for k, v in arrays.items():
+                    np.testing.assert_array_equal(out[k], v)
+        finally:
+            a.close()
+            b.close()
+
+
+class _CountingEcho:
+    def __init__(self):
+        self.applies = 0
+        self.lock = threading.Lock()
+
+    def __call__(self, header, arrays):
+        with self.lock:
+            self.applies += 1
+            return {"ok": True, "n": self.applies, "i": header.get("i")}, {}
+
+
+class TestPipelinedClient:
+    def test_window_of_futures_completes_in_order(self):
+        handler = _CountingEcho()
+        srv = RpcServer(handler).start()
+        cli = RpcClient(srv.address, window=4)
+        try:
+            futs = [cli.call_async("echo", i=i) for i in range(20)]
+            reps = [f.result(timeout=30)[0] for f in futs]
+            # every reply matched to ITS request (the _rseq echo), and the
+            # serial per-connection dispatch preserves order
+            assert [r["i"] for r in reps] == list(range(20))
+            assert [r["n"] for r in reps] == list(range(1, 21))
+            assert handler.applies == 20
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_window_bounds_inflight(self):
+        release = threading.Event()
+
+        def slow(header, arrays):
+            release.wait(5)
+            return {"ok": True}, {}
+
+        srv = RpcServer(slow).start()
+        cli = RpcClient(srv.address, window=3)
+        try:
+            done = []
+
+            def issue():
+                futs = [cli.call_async("x") for _ in range(6)]
+                done.append(futs)
+
+            t = threading.Thread(target=issue, daemon=True)
+            t.start()
+            time.sleep(0.3)
+            # the 4th call_async must have BLOCKED on the full window
+            assert not done
+            assert wire_counters.get("rpc_inflight_peak") <= 3
+            release.set()
+            t.join(timeout=30)
+            assert done
+            for f in done[0]:
+                f.result(timeout=30)
+        finally:
+            release.set()
+            cli.close()
+            srv.stop()
+
+    def test_sync_call_still_works_and_raises_remote_errors(self):
+        def handler(header, arrays):
+            raise ValueError("nope")
+
+        srv = RpcServer(handler).start()
+        cli = RpcClient(srv.address)
+        try:
+            with pytest.raises(RuntimeError, match="nope"):
+                cli.call("boom")
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_concurrent_sync_callers_share_the_window(self):
+        handler = _CountingEcho()
+        srv = RpcServer(handler).start()
+        cli = RpcClient(srv.address, window=8)
+        got = []
+        lock = threading.Lock()
+
+        def worker(k):
+            for _ in range(10):
+                rep, _ = cli.call("echo", i=k)
+                with lock:
+                    got.append(rep["i"])
+
+        try:
+            ts = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            assert sorted(got) == sorted([k for k in range(4) for _ in range(10)])
+            assert handler.applies == 40
+        finally:
+            cli.close()
+            srv.stop()
+
+    @pytest.mark.parametrize(
+        "spec",
+        ["drop,every=3", "disconnect,every=3", "duplicate,every=2"],
+    )
+    def test_chaos_with_pipelined_window_exactly_once(self, spec):
+        """W>1 in flight under frame chaos: reconnect + whole-window
+        resend + the server reply cache keep every request applied exactly
+        once, with each reply matched to its own future (no cross-request
+        corruption)."""
+        handler = _CountingEcho()
+        srv = RpcServer(
+            handler, fault_plan=FaultPlan.parse(spec, seed=7)
+        ).start()
+        cli = RpcClient(srv.address, window=4, reconnect_timeout_s=30.0)
+        try:
+            futs = [cli.call_async("echo", i=i) for i in range(24)]
+            reps = [f.result(timeout=60)[0] for f in futs]
+            assert [r["i"] for r in reps] == list(range(24))
+            assert handler.applies == 24  # exactly once, whole window
+            if spec.startswith("disconnect"):
+                # applied-but-reply-lost must be answered from the cache
+                assert wire_counters.get("rpc_dedup_hits") >= 1
+                assert wire_counters.get("rpc_reconnects") >= 1
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_mixed_chaos_window_soak(self):
+        handler = _CountingEcho()
+        plan = FaultPlan.parse(
+            "drop,prob=0.04;disconnect,prob=0.04;duplicate,prob=0.04",
+            seed=1234,
+        )
+        srv = RpcServer(handler, fault_plan=plan).start()
+        cli = RpcClient(srv.address, window=8, reconnect_timeout_s=30.0)
+        try:
+            futs = [cli.call_async("echo", i=i) for i in range(120)]
+            reps = [f.result(timeout=60)[0] for f in futs]
+            assert [r["i"] for r in reps] == list(range(120))
+            assert handler.applies == 120
+            stats = srv.fault_stats()
+            assert sum(v for k, v in stats.items() if k != "frames") >= 3
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_closed_client_fails_inflight_futures(self):
+        block = threading.Event()
+
+        def parked(header, arrays):
+            block.wait(10)
+            return {"ok": True}, {}
+
+        srv = RpcServer(parked).start()
+        cli = RpcClient(srv.address, window=2)
+        try:
+            f = cli.call_async("x")
+            time.sleep(0.1)
+            cli.close()
+            with pytest.raises(ConnectionError):
+                f.result(timeout=10)
+        finally:
+            block.set()
+            srv.stop()
+
+
+class TestLruSigs:
+    def test_eviction_order_and_cap(self):
+        lru = _LruSigs(cap=3)
+        for k in "abc":
+            lru.put(k, k.upper())
+        assert len(lru) == 3
+        assert lru.get("a") == "A"  # refresh a
+        lru.put("d")  # evicts b (least recently used)
+        assert "b" not in lru
+        assert "a" in lru and "c" in lru and "d" in lru
+        assert len(lru) == 3
+
+    def test_get_refreshes_recency(self):
+        lru = _LruSigs(cap=2)
+        lru.put("x", 1)
+        lru.put("y", 2)
+        assert lru.get("x") == 1
+        lru.put("z", 3)  # y is now the LRU entry
+        assert "y" not in lru and "x" in lru
+
+    def test_concurrent_put_get(self):
+        lru = _LruSigs(cap=64)
+
+        def hammer(base):
+            for i in range(300):
+                lru.put((base, i % 100), i)
+                lru.get((base, (i * 7) % 100))
+
+        ts = [threading.Thread(target=hammer, args=(b,)) for b in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(lru) <= 64
+
+
+class TestNeedKeysBounceUnderWindow:
+    def _server_and_handle(self, key_cache_cap=1):
+        from parameter_server_tpu.kv.updaters import Sgd
+        from parameter_server_tpu.parallel.multislice import (
+            ServerHandle,
+            ShardServer,
+        )
+        from parameter_server_tpu.utils.config import PSConfig
+        from parameter_server_tpu.utils.keyrange import KeyRange
+
+        srv = ShardServer(Sgd(eta=1.0), KeyRange(0, 1024)).start()
+        srv._key_cache = _LruSigs(cap=key_cache_cap)
+        cfg = PSConfig()
+        handle = ServerHandle(srv.address, 0, 0, cfg, range_size=1024)
+        return srv, handle
+
+    def test_cache_miss_mid_window_does_not_corrupt_neighbours(self):
+        """The regression the tentpole must not introduce: a need_keys
+        bounce on request k (evicted sig) while requests k+1..k+W are in
+        flight must re-issue ONLY k, and every push must land exactly
+        once with its own keys/grads pairing."""
+        srv, handle = self._server_and_handle(key_cache_cap=1)
+        try:
+            sets = [
+                np.arange(1 + 64 * s, 1 + 64 * (s + 1), dtype=np.int64)
+                for s in range(4)
+            ]
+            grads = [
+                np.full(64, float(s + 1), dtype=np.float32)
+                for s in range(4)
+            ]
+            # prime every sig into the HANDLE's sent-sig memory while the
+            # server's 1-entry cache forgets all but the last
+            for s in range(4):
+                handle.push(sets[s], np.zeros(64, np.float32))
+            # window of 4 pushes: sets 0..2 bounce (evicted), 3 may hit
+            futs = [
+                handle.push_async(sets[s], grads[s]) for s in range(4)
+            ]
+            for f in futs:
+                f.result(timeout=30)
+            w = handle.pull(np.arange(1, 257, dtype=np.int64))
+            # SGD with eta=1: w = -sum(g) per key — each set got exactly
+            # its own gradient exactly once
+            expect = -np.concatenate(grads)
+            np.testing.assert_allclose(w, expect, rtol=1e-6)
+            assert srv.counters["need_keys"] >= 1
+        finally:
+            handle.shutdown()
+            handle.close()
+
+    def test_pull_async_bounce(self):
+        srv, handle = self._server_and_handle(key_cache_cap=1)
+        try:
+            k1 = np.arange(1, 65, dtype=np.int64)
+            k2 = np.arange(65, 129, dtype=np.int64)
+            handle.push(k1, np.full(64, 2.0, np.float32))
+            handle.push(k2, np.full(64, 3.0, np.float32))  # evicts k1's sig
+            outs = [handle.pull_async(k) for k in (k1, k2)]
+            np.testing.assert_allclose(
+                outs[0].result(timeout=30), np.full(64, -2.0), rtol=1e-6
+            )
+            np.testing.assert_allclose(
+                outs[1].result(timeout=30), np.full(64, -3.0), rtol=1e-6
+            )
+            assert srv.counters["need_keys"] >= 1
+        finally:
+            handle.shutdown()
+            handle.close()
+
+
+class TestPushWindow:
+    def _fut(self, done=True):
+        f = Future()
+        if done:
+            f.set_result(None)
+        return f
+
+    def test_gate_retires_done_heads_and_bounds(self):
+        retired = []
+        w = PushWindow(2, retire=retired.append)
+        w.add(0, [self._fut()])
+        w.add(1, [self._fut(done=False)])
+        w.gate()  # head done -> retired; step 1 pending, under bound
+        assert retired == [0] and len(w) == 1
+
+    def test_bound_blocks_on_oldest(self):
+        retired = []
+        w = PushWindow(1, retire=retired.append)
+        slow = Future()
+        w.add(0, [slow])
+        w.add(1, [self._fut()])
+        threading.Timer(0.2, slow.set_result, args=(None,)).start()
+        t0 = time.perf_counter()
+        w.gate()  # over the bound: must block on step 0's future
+        assert time.perf_counter() - t0 >= 0.15
+        # step 0 retired first (the block); step 1's done head drains too
+        assert retired == [0, 1]
+
+    def test_wait_all_is_full_sync_point(self):
+        retired = []
+        w = PushWindow(8, retire=retired.append)
+        futs = [Future() for _ in range(3)]
+        for i, f in enumerate(futs):
+            w.add(i, [f])
+        for f in futs:
+            f.set_result(None)
+        w.wait_all()
+        assert retired == [0, 1, 2] and len(w) == 0
+
+    def test_push_error_surfaces_at_retire(self):
+        w = PushWindow(0, retire=lambda s: None)
+        f = Future()
+        f.set_exception(RuntimeError("push died"))
+        w.add(0, [f])
+        with pytest.raises(RuntimeError, match="push died"):
+            w.wait_all()
+
+    def test_max_inflight_pushes_config_plumbed(self):
+        from parameter_server_tpu.utils.config import PSConfig
+
+        cfg = PSConfig()
+        assert cfg.wire.window == 8
+        assert cfg.wire.max_inflight_pushes == 0  # derive from max_delay

@@ -231,6 +231,13 @@ class TestTelemetrySnapshotMerge:
         }
         m = merge_telemetry([a, b])
         assert m["counters"] == {"x": 6, "y": 2}
+        # high-watermark gauges (*_peak) merge as a MAX, not a sum: the
+        # cluster view must never report a window depth nothing reached
+        mp = merge_telemetry([
+            {"counters": {"rpc_inflight_peak": 8, "n": 1}},
+            {"counters": {"rpc_inflight_peak": 3, "n": 2}},
+        ])
+        assert mp["counters"] == {"rpc_inflight_peak": 8, "n": 3}
         assert m["hists"]["client.push"]["count"] == 3
         assert m["hists"]["client.push"]["buckets"] == {"10": 2, "12": 1}
         assert m["hists"]["server.pull"]["count"] == 1

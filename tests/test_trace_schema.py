@@ -18,7 +18,7 @@ import pytest
 
 from parameter_server_tpu.utils import trace
 
-_VALID_PH = {"X", "i", "M"}
+_VALID_PH = {"X", "i", "M", "s", "f"}
 
 
 def _validate_chrome_trace(path: Path) -> list[dict]:
@@ -40,6 +40,10 @@ def _validate_chrome_trace(path: Path) -> list[dict]:
         last_ts = ev["ts"]
         if ev["ph"] == "X":
             assert ev["dur"] >= 0
+        if ev["ph"] in ("s", "f"):  # flow arrows carry a linking id
+            assert isinstance(ev["id"], str) and ev["id"]
+        if ev["ph"] == "f":
+            assert ev["bp"] == "e"  # enclosing-slice binding
     return events
 
 
@@ -130,6 +134,68 @@ class TestTwoProcessTrace:
             child.stdout.close()
 
 
+class TestFlowEvents:
+    """Span links for in-flight push futures (the PR-2 ROADMAP item):
+    every async push emits a flow-start inside its issue span and a
+    flow-end at completion — same id, so Perfetto draws the arrow across
+    the in-flight window (and across threads)."""
+
+    def test_push_async_emits_matched_flow_pairs(self, tmp_path):
+        import numpy as np
+
+        from parameter_server_tpu.kv.updaters import Sgd
+        from parameter_server_tpu.parallel.multislice import (
+            ServerHandle,
+            ShardServer,
+        )
+        from parameter_server_tpu.utils.config import PSConfig
+        from parameter_server_tpu.utils.keyrange import KeyRange
+
+        trace.configure(str(tmp_path), process_name="flow-test")
+        try:
+            srv = ShardServer(Sgd(eta=0.1), KeyRange(0, 1024)).start()
+            handle = ServerHandle(
+                srv.address, 0, 0, PSConfig(), range_size=1024
+            )
+            keys = np.arange(1, 33, dtype=np.int64)
+            g = np.ones(32, dtype=np.float32)
+            futs = [handle.push_async(keys, g) for _ in range(5)]
+            for f in futs:
+                f.result(timeout=30)
+            w = handle.pull_async(keys).result(timeout=30)
+            assert w.shape == (32,)
+            handle.shutdown()
+            handle.close()
+            path = Path(trace.tracer.flush())
+        finally:
+            trace.configure(None)
+        events = _validate_chrome_trace(path)
+        starts = [e for e in events if e["ph"] == "s"]
+        ends = [e for e in events if e["ph"] == "f"]
+        push_starts = [e for e in starts if e["name"] == "ps.push.inflight"]
+        assert len(push_starts) == 5
+        # every flow start has exactly one matching end: same id AND name
+        end_ids = {(e["name"], e["id"]) for e in ends}
+        for s in starts:
+            assert (s["name"], s["id"]) in end_ids, s
+        assert len(end_ids) == len(starts)
+        # the flow start rides the issue span's trace (args carry its ids)
+        issue_spans = {
+            e["args"]["span_id"]: e["args"]["trace_id"]
+            for e in _spans(events, "ps.push")
+        }
+        for s in push_starts:
+            assert s["args"]["parent_id"] in issue_spans
+            assert s["args"]["trace_id"] == issue_spans[s["args"]["parent_id"]]
+
+    def test_flow_api_disabled_is_free(self):
+        t = trace.Tracer(None)
+        fid = t.flow_start("nope", cat="x")
+        assert fid is None
+        t.flow_end("nope", cat="x", flow_id=fid)  # no-op on the None id
+        assert t.events() == []
+
+
 class TestDisabledTracingIsFree:
     def test_noop_path_allocates_no_spans(self):
         t = trace.Tracer(None)
@@ -217,25 +283,21 @@ class TestTracerEnabled:
         assert inst and inst[0]["args"]["trace_id"] == c.trace_id
 
     def test_step_context_carries_onto_pool_threads(self, armed):
-        # thread locals don't cross ThreadPoolExecutor: the worker loop
-        # captures the step span's context and re-activates it on pool
-        # threads (_with_trace_ctx), so per-server RPC spans join the
-        # step's trace instead of starting their own
+        # thread locals don't cross ThreadPoolExecutor: a captured wire
+        # context re-activated on another thread (trace.activate — the
+        # mechanism the async completion callbacks use) makes spans there
+        # join the originating trace instead of starting their own
         from concurrent.futures import ThreadPoolExecutor
 
-        from parameter_server_tpu.parallel.multislice import _with_trace_ctx
-
-        def pool_side():
-            with trace.span("ps.pull"):
+        def pool_side(ctx=None):
+            with trace.activate(ctx), trace.span("ps.pull"):
                 return True
 
         with ThreadPoolExecutor(max_workers=2) as pool:
             with trace.span("step") as stp:
                 ctx = trace.wire_context()
                 bare = pool.submit(pool_side).result()
-                linked = pool.submit(
-                    _with_trace_ctx, ctx, pool_side
-                ).result()
+                linked = pool.submit(pool_side, ctx).result()
             assert bare and linked
         pulls = _spans(armed.events(), "ps.pull")
         assert len(pulls) == 2
