@@ -78,6 +78,12 @@ Sub-benches ("sub"):
                  process telemetry snapshot is embedded in the full
                  results as "telemetry", so BENCH_* rounds track RPC
                  latency alongside throughput.
+  server_apply — shard-server batched apply engine A/B on loopback: push
+                 throughput at 8 concurrent pipelined clients with the
+                 apply engine ON (coalesced, single-dispatch batches)
+                 vs OFF (the serial per-push lock), plus small-frame
+                 (4 KiB) pipelined push rps with binary vs JSON headers
+                 against a separate-process ack server.
   last_tpu_capture — present only on a CPU fallback: names the newest
                  committed BENCH_r*_local.json real-hardware capture.
 """
@@ -119,12 +125,14 @@ CHILD_BUDGET_S = {
     "darlin": 300,
     "ingest": 240,
     "wire_rpc": 300,
+    "server_apply": 360,
 }
 # run order = value order: the contract fields land first, platform-bound
 # numbers next, platform-independent ones last
 CHILD_ORDER = (
     "headline", "pipeline_e2e", "hbm_scale", "ladder", "scale", "word2vec",
     "matrix_fac", "darlin", "spmd_push", "wd_push", "ingest", "wire_rpc",
+    "server_apply",
 )
 
 
@@ -1274,6 +1282,196 @@ def child_wire_rpc() -> dict:
     return out
 
 
+def child_server_apply() -> dict:
+    """Shard-server batched apply engine A/B, two blocks:
+
+    1. Push throughput at W=8 concurrent pipelined clients against a real
+       ShardServer on loopback, apply engine ON (pushes coalesce into
+       segment-summed single-dispatch batches; pulls serve from the RCU
+       snapshot) vs OFF ([server] apply_queue=0 — every push applies
+       inline under the global lock, the pre-engine discipline).
+       Interleaved rounds, median per-round ratio.
+    2. Small-frame rps: 4 KiB pipelined pushes against a separate-process
+       ack server with binary vs JSON headers (same interleaved-rounds
+       discipline), plus the hdr_bytes_saved the codec banked."""
+    import statistics as stats
+    import subprocess
+    import sys as sys_mod
+    import threading
+
+    from parameter_server_tpu.kv.updaters import Ftrl
+    from parameter_server_tpu.parallel.control import RpcClient
+    from parameter_server_tpu.parallel.multislice import ServerHandle, ShardServer
+    from parameter_server_tpu.utils.config import PSConfig, ServerConfig
+    from parameter_server_tpu.utils.keyrange import KeyRange
+    from parameter_server_tpu.utils.metrics import (
+        hist_percentile,
+        latency_histograms,
+        telemetry_snapshot,
+        wire_counters,
+    )
+
+    n_keys = 1 << 18
+    W, per_client = 8, 120
+    rng = np.random.default_rng(7)
+    keysets = [
+        np.unique(rng.integers(1, n_keys, 1024)).astype(np.int64)
+        for _ in range(W)
+    ]
+    gradsets = [
+        rng.normal(size=len(k)).astype(np.float32) for k in keysets
+    ]
+
+    def _push_rate(batched: bool) -> float:
+        scfg = ServerConfig() if batched else ServerConfig(apply_queue=0)
+        srv = ShardServer(
+            Ftrl(alpha=ALPHA, beta=BETA, lambda_l1=L1, lambda_l2=L2),
+            KeyRange(0, n_keys), server_cfg=scfg,
+        ).start()
+        handles = [
+            ServerHandle(srv.address, 0, w, PSConfig(), range_size=n_keys)
+            for w in range(W)
+        ]
+        try:
+            for h, k, g in zip(handles, keysets, gradsets):  # warmup + sigs
+                h.push(k, g)
+            # concurrent warmup burst: compiles the engine's pow-2 union
+            # buckets before the timed window
+            futs = [
+                h.push_async(k, g)
+                for h, k, g in zip(handles, keysets, gradsets)
+            ]
+            for f in futs:
+                f.result(timeout=120)
+            barrier = threading.Barrier(W)
+            errs: list = []
+
+            def run(i: int) -> None:
+                try:
+                    barrier.wait()
+                    futs = [
+                        handles[i].push_async(keysets[i], gradsets[i])
+                        for _ in range(per_client)
+                    ]
+                    for f in futs:
+                        f.result(timeout=120)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errs.append(e)
+            ts = [
+                threading.Thread(target=run, args=(i,)) for i in range(W)
+            ]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=180)
+            dt = time.perf_counter() - t0
+            if errs:
+                raise errs[0]
+            return W * per_client / dt
+        finally:
+            handles[0].shutdown()
+            for h in handles:
+                h.close()
+
+    coalesced0 = wire_counters.get("push_coalesced")
+    # same ABBA symmetry as the header cell below: serial, batched,
+    # batched, serial per round, harmonic-combined — monotonic host-load
+    # drift cancels inside each round's ratio instead of flattering
+    # whichever mode runs later
+    n_round = W * per_client
+    rounds = []
+    for _ in range(2):
+        s1 = _push_rate(False)
+        b1 = _push_rate(True)
+        b2 = _push_rate(True)
+        s2 = _push_rate(False)
+        rounds.append((
+            2 * n_round / (n_round / s1 + n_round / s2),
+            2 * n_round / (n_round / b1 + n_round / b2),
+        ))
+    out: dict = {
+        "platform": "cpu-loopback",
+        "clients": W,
+        "push_rps_serial_w8": round(stats.median(r[0] for r in rounds), 1),
+        "push_rps_batched_w8": round(stats.median(r[1] for r in rounds), 1),
+        "batched_speedup_w8": round(
+            stats.median(b / s for s, b in rounds), 2
+        ),
+        "push_coalesced": wire_counters.get("push_coalesced") - coalesced0,
+    }
+    bsnap = latency_histograms.snapshot().get("server.apply_batch.n")
+    if bsnap:
+        # observe_scalar convention: value percentiles recover via * 1e6
+        out["batch_p50"] = round(hist_percentile(bsnap, 0.5) * 1e6, 1)
+        out["batch_p99"] = round(hist_percentile(bsnap, 0.99) * 1e6, 1)
+
+    # -- block 2: binary vs JSON headers at 4 KiB frames (ack server in
+    # its own process so the codec cost isn't masked by a shared GIL)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    ack = subprocess.Popen(
+        [sys_mod.executable, "-c", _ACK_SERVER_CODE.format(repo=repo)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        line = ack.stdout.readline()
+        if not line.startswith("ADDR "):
+            err = (ack.stderr.read() or "no stderr").strip()[-400:]
+            raise RuntimeError(f"ack server failed to start: {err}")
+        addr = line.split()[1]
+        payload = {"g": rng.normal(size=1024).astype(np.float32)}  # 4 KiB
+        saved0 = wire_counters.get("hdr_bytes_saved")
+        clients = {
+            c: RpcClient(addr, window=8, hdr_codec=c) for c in ("json", "bin")
+        }
+        for cli in clients.values():  # settle TCP, negotiate codecs
+            fs = [cli.call_async("push", arrays=payload) for _ in range(100)]
+            for f in fs:
+                f.result()
+
+        def _elapsed(cli, n: int = 250) -> float:
+            t0 = time.perf_counter()
+            fs = [cli.call_async("push", arrays=payload) for _ in range(n)]
+            for f in fs:
+                f.result()
+            return time.perf_counter() - t0
+
+        # symmetric ABBA rounds (json, bin, bin, json): linear load drift
+        # on a shared host cancels exactly inside each round's ratio,
+        # instead of biasing whichever codec ran later
+        hdr_rounds = []
+        for _ in range(6):
+            tj1 = _elapsed(clients["json"])
+            tb1 = _elapsed(clients["bin"])
+            tb2 = _elapsed(clients["bin"])
+            tj2 = _elapsed(clients["json"])
+            hdr_rounds.append((500 / (tj1 + tj2), 500 / (tb1 + tb2)))
+        out["push_rps_4k_json"] = round(
+            stats.median(r[0] for r in hdr_rounds), 1
+        )
+        out["push_rps_4k_bin"] = round(
+            stats.median(r[1] for r in hdr_rounds), 1
+        )
+        out["hdr_speedup_4k"] = round(
+            stats.median(b / j for j, b in hdr_rounds), 3
+        )
+        out["hdr_bytes_saved"] = (
+            wire_counters.get("hdr_bytes_saved") - saved0
+        )
+        for cli in clients.values():
+            cli.close()
+    finally:
+        ack.kill()
+        try:
+            ack.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        ack.stdout.close()
+        ack.stderr.close()
+    out["telemetry"] = telemetry_snapshot()
+    return out
+
+
 _CHILDREN = {
     "headline": child_headline,
     "pipeline_e2e": child_pipeline_e2e,
@@ -1287,6 +1485,7 @@ _CHILDREN = {
     "wd_push": child_wd_push,
     "ingest": child_ingest,
     "wire_rpc": child_wire_rpc,
+    "server_apply": child_server_apply,
 }
 
 
@@ -1414,18 +1613,19 @@ def main() -> None:
 
     results: dict = {}
     for name in CHILD_ORDER:
-        # wire_rpc measures host TCP + updater latency, never the
-        # accelerator: pin it to CPU like the cpu-sim meshes so a wedged
-        # tunnel can't take the telemetry block down with it
+        # wire_rpc/server_apply measure host TCP + updater latency, never
+        # the accelerator: pin them to CPU like the cpu-sim meshes so a
+        # wedged tunnel can't take the telemetry block down with it
         child_env = (
             _cpu_sim_env()
-            if name in ("spmd_push", "wd_push", "wire_rpc")
+            if name in ("spmd_push", "wd_push", "wire_rpc", "server_apply")
             else env
         )
         r = _run_child(name, child_env, CHILD_BUDGET_S[name])
         results[name] = r
-        if "error" in r and name not in ("spmd_push", "wd_push", "wire_rpc") \
-                and not degraded:
+        if "error" in r and not degraded and name not in (
+            "spmd_push", "wd_push", "wire_rpc", "server_apply"
+        ):
             # the accelerator may have wedged mid-suite: re-probe, and run
             # everything that's left on the CPU fallback if it's gone
             if _probe_backend(env, timeout_s=90.0) is None:
@@ -1503,6 +1703,7 @@ def main() -> None:
             "wd_push": results.get("wd_push", {}),
             "ingest": results.get("ingest", {}),
             "wire_rpc": wire_rpc,
+            "server_apply": results.get("server_apply", {}),
         },
         "suite_wall_s": round(time.perf_counter() - t_start, 1),
         **extra,
@@ -1585,6 +1786,12 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
                 "wire_rpc", "roundtrips_per_sec", "pull_p50_ms",
                 "push_p99_ms", "pipelined_speedup_w8",
                 "mb_s_1mib_pipelined"),
+            # the batched apply engine's acceptance ratios (ISSUE 4):
+            # batched-vs-serial push throughput at 8 pipelined clients
+            # and binary-vs-JSON header rps at 4 KiB frames
+            "srv": _pick(
+                "server_apply", "batched_speedup_w8",
+                "push_rps_batched_w8", "hdr_speedup_4k"),
         },
     }
     if "last_tpu_capture" in full:

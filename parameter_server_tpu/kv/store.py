@@ -58,6 +58,80 @@ def materialize_weights(updater: Updater, state: State) -> jax.Array:
     return updater.weights(state)
 
 
+def coalesce_pushes(
+    idx_list: list[np.ndarray],
+    grad_list: list[np.ndarray],
+    pad_to_pow2: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pre-aggregate several concurrent pushes into ONE (idx, grad) pair
+    honoring the store invariant: each real key at most once, duplicate
+    keys segment-summed. This is the host-side half of the server's
+    batched apply engine — N pushes (possibly from N different clients,
+    with overlapping key sets) collapse into one updater apply, and a
+    nonlinear updater (FTRL) sees each gradient contribution exactly once
+    in the aggregate, matching the paper's aggregated server updates.
+
+    ``pad_to_pow2`` pads the union with PAD_KEY (0) rows carrying zero
+    gradient — the same slot semantics the data layer's localizer
+    guarantees (row 0 absorbs zero-gradient updates). Coalesced unions
+    otherwise have a DIFFERENT length every batch, and on the eager
+    server tier each fresh shape re-dispatches/compiles the whole updater
+    chain — the pow-2 bucket pins batches to a handful of shapes (the
+    ``bucket_nnz`` idiom applied to the server's apply path).
+
+    ``grad_list`` entries are (U_i, vdim) (or (U_i,), normalized here);
+    returns (unique_idx, (U, vdim) summed grads) as numpy host arrays.
+    """
+    if len(idx_list) == 1:
+        uniq = np.asarray(idx_list[0])
+        summed = np.asarray(grad_list[0]).reshape(len(uniq), -1)
+        # a single push carries no duplicates (the localizer contract) —
+        # pass through, padding only if asked
+    else:
+        idx = np.concatenate([np.asarray(i) for i in idx_list])
+        g = np.concatenate(
+            [
+                np.asarray(x).reshape(len(i), -1)
+                for i, x in zip(idx_list, grad_list)
+            ]
+        )
+        uniq, inv = np.unique(idx, return_inverse=True)
+        summed = np.zeros((len(uniq), g.shape[1]), dtype=g.dtype)
+        np.add.at(summed, inv, g)
+    if pad_to_pow2:
+        u = len(uniq)
+        cap = 1 << max(u - 1, 0).bit_length()
+        if cap > u:
+            uniq = np.concatenate([uniq, np.zeros(cap - u, uniq.dtype)])
+            summed = np.concatenate(
+                [summed, np.zeros((cap - u, summed.shape[1]), summed.dtype)]
+            )
+    return uniq, summed
+
+
+def push_multi(
+    updater: Updater,
+    state: State,
+    idx_list: list[np.ndarray],
+    grad_list: list[np.ndarray],
+    pad_to_pow2: bool = False,
+) -> State:
+    """Batched multi-push: coalesce N pushes (segment-summing duplicate
+    keys across them) and apply the updater ONCE over the union of
+    touched rows — one dispatch instead of N. Semantics are the paper's
+    server-side aggregation: deltas are computed from the pre-batch rows
+    and the summed gradient.
+
+    This is the single-program (KVStore) batched entry point. The wire
+    tier's ``ShardServer`` apply engine composes the SAME two primitives
+    (``coalesce_pushes`` + ``push``) directly, because its durable push
+    ledger and RCU publish must share one critical section with the
+    apply — semantics changes to batching belong in those primitives,
+    where both paths pick them up."""
+    idx, grad = coalesce_pushes(idx_list, grad_list, pad_to_pow2)
+    return push(updater, state, jnp.asarray(idx), jnp.asarray(grad))
+
+
 class KVStore:
     """Stateful convenience wrapper an app holds (one sharded "server group").
 
@@ -82,6 +156,13 @@ class KVStore:
 
     def push(self, idx: jax.Array, grad: jax.Array) -> None:
         self.state = push(self.updater, self.state, idx, grad)
+
+    def push_multi(
+        self, idx_list: list[np.ndarray], grad_list: list[np.ndarray]
+    ) -> None:
+        """Apply N pushes as one coalesced, segment-summed update (the
+        batched server apply; see module-level ``push_multi``)."""
+        self.state = push_multi(self.updater, self.state, idx_list, grad_list)
 
     def weights(self) -> jax.Array:
         return materialize_weights(self.updater, self.state)

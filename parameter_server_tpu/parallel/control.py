@@ -16,11 +16,22 @@ reference's message filters become meaningful again.
 
 Wire format (ref: Message = Task proto header + SArray payloads):
 
-    u32 header_len | u32 payload_len | header JSON | payload bytes
+    u32 header_len | u32 payload_len | header bytes | payload bytes
 
 The header carries the command and scalar fields; ``arrays`` in the header
 describes the (name, dtype, shape, compressed_len) of each contiguous numpy
-payload chunk. The payload path is zero-copy end to end: ``send_frame``
+payload chunk. Header bytes come in TWO self-describing codecs, sniffed by
+the first byte: ``{`` (0x7B) is the JSON codec every version understands;
+``0xB7`` opens the versioned fixed-layout BINARY codec (struct-packed
+magic / version / flags / cmd-id / seq / cid / array-descriptor table,
+with a JSON tail for residual fields). Binary is negotiated per
+connection: a client that prefers it sends JSON requests carrying
+``_bh: 1`` until a reply confirms the peer decodes binary (the reply is
+binary, or JSON carrying ``_bh: 1``); only then does the connection
+switch — so a mixed-version cluster degrades to JSON instead of
+crashing an old peer. Servers simply echo the request's codec.
+
+The payload path is zero-copy end to end: ``send_frame``
 gathers the length word, the header, and each array's ``memoryview``
 straight into ``socket.sendmsg`` (no ``tobytes``/``join`` concatenation),
 and the receiver lands the whole payload in ONE preallocated buffer that
@@ -72,6 +83,8 @@ from parameter_server_tpu.parallel.workload import WorkloadPool
 from parameter_server_tpu.utils import trace
 from parameter_server_tpu.utils.heartbeat import HeartbeatMonitor
 from parameter_server_tpu.utils.metrics import (
+    Histogram,
+    hist_percentile,
     latency_histograms,
     merge_progress,
     merge_telemetry,
@@ -172,6 +185,292 @@ def _try_compress(view) -> bytes | None:
     return comp
 
 
+# ---------------------------------------------------------------------------
+# binary header codec (versioned fixed layout; ref: the protobuf Task header
+# the reference packed instead of a text format). json.dumps/json.loads on
+# every frame was a visible share of small-frame cost once the payload path
+# went zero-copy — the codec replaces it for the fields every data-plane
+# frame carries, with a JSON tail for anything else.
+# ---------------------------------------------------------------------------
+
+_BMAGIC = 0xB7  # first header byte; JSON always starts with '{' (0x7B)
+_BVERSION = 1
+
+# flags1
+_BF_CID = 1
+_BF_SEQ = 2
+_BF_RSEQ = 4
+_BF_EXTRA = 8
+_BF_OK_TRUE = 16
+_BF_OK_FALSE = 32
+_BF_ZIP = 64
+_BF_CMD_STR = 128
+# flags2
+_BF2_WORKER = 1
+_BF2_SIG = 2
+_BF2_CODEC = 4
+_BF2_NEED_KEYS = 8
+_BF2_TRANSIENT = 16
+
+_BFIX = struct.Struct("<BBBBBH")  # magic, version, flags1, flags2, cmd_id, narrays
+_I32 = struct.Struct("<i")
+_I64 = struct.Struct("<q")
+_U32 = struct.Struct("<I")
+
+#: cmd -> compact id (1-based; 0 = absent/unknown). Append-only: ids are
+#: wire contract across versions.
+_CMD_IDS: dict[str, int] = {
+    c: i + 1
+    for i, c in enumerate((
+        "push", "pull", "dump", "stats", "shutdown", "register", "nodes",
+        "barrier", "kv_set", "kv_get", "workload_init", "workload_fetch",
+        "workload_finish", "workload_stats", "workload_reassign", "progress",
+        "progress_merged", "beat", "telemetry", "dead", "recovered",
+        "ssp_init", "ssp_wait", "ssp_finish", "ssp_retire", "ssp_progress",
+        "echo",
+    ))
+}
+_CMD_NAMES = {i: c for c, i in _CMD_IDS.items()}
+
+_B1 = tuple(bytes((i,)) for i in range(256))  # single-byte length prefixes
+
+
+def _vstr(s: str) -> bytes | None:
+    b = s.encode()
+    if len(b) > 255:
+        return None
+    return _B1[len(b)] + b
+
+
+def _seq_bytes(v) -> bytes | None:
+    if type(v) is int:
+        if not (-(1 << 63) <= v < (1 << 63)):
+            return None
+        return b"\x00" + _I64.pack(v)
+    if type(v) is str:
+        vs = _vstr(v)
+        return None if vs is None else b"\x01" + vs
+    return None
+
+
+def _encode_bin_header(h: dict[str, Any], metas: list) -> bytes | None:
+    """Encode a header dict + array-descriptor table into the binary
+    layout; None when a field can't be represented at all (the caller
+    falls back to JSON — correctness never depends on the binary codec
+    applying; a merely slot-unfit field rides the JSON tail instead).
+
+    ``hdr_bytes_saved`` is counted against an in-loop ESTIMATE of the
+    length json.dumps would have produced (running the real thing per
+    frame is exactly the cost this codec removes) — accurate to a few
+    bytes per frame."""
+    flags1 = flags2 = 0
+    cmd_id = 0
+    cmd_b = cid_b = seq_b = rseq_b = worker_b = sig_b = codec_b = None
+    extra: dict[str, Any] | None = None
+    est = 14  # {} plus "arrays": []
+    for k, v in h.items():
+        if k == "cmd":
+            if type(v) is not str:
+                return None
+            cmd_id = _CMD_IDS.get(v, 0)
+            if cmd_id == 0:
+                cmd_b = _vstr(v)
+                if cmd_b is None:
+                    return None
+                flags1 |= _BF_CMD_STR
+            est += 9 + len(v)
+        elif k == "_cid" and type(v) is str and (cid_b := _vstr(v)) is not None:
+            flags1 |= _BF_CID
+            est += 10 + len(v)
+        elif k == "_seq" and (seq_b := _seq_bytes(v)) is not None:
+            flags1 |= _BF_SEQ
+            est += 10 + (len(str(v)) if type(v) is int else len(v) + 2)
+        elif k == "_rseq" and (rseq_b := _seq_bytes(v)) is not None:
+            flags1 |= _BF_RSEQ
+            est += 11 + (len(str(v)) if type(v) is int else len(v) + 2)
+        elif k == "ok" and v is True:
+            flags1 |= _BF_OK_TRUE
+            est += 12
+        elif k == "ok" and v is False:
+            flags1 |= _BF_OK_FALSE
+            est += 13
+        elif k == "zip" and type(v) is bool:
+            if v:
+                flags1 |= _BF_ZIP
+            est += 14
+        elif k == "need_keys" and v is True:
+            flags2 |= _BF2_NEED_KEYS
+            est += 18
+        elif k == "_transient" and v is True:
+            flags2 |= _BF2_TRANSIENT
+            est += 19
+        elif (
+            k == "worker" and type(v) is int and -(1 << 31) <= v < (1 << 31)
+        ):
+            flags2 |= _BF2_WORKER
+            worker_b = _I32.pack(v)
+            est += 12 + len(str(v))
+        elif k == "sig" and type(v) is str and (sig_b := _vstr(v)) is not None:
+            flags2 |= _BF2_SIG
+            est += 9 + len(v)
+        elif k == "codec" and type(v) is int and 0 <= v < 256:
+            flags2 |= _BF2_CODEC
+            codec_b = _B1[v]
+            est += 11
+        else:
+            if extra is None:
+                extra = {}
+            extra[k] = v
+    parts: list[bytes] = [b""]  # slot 0: the fixed prefix, packed below
+    if cmd_b is not None:
+        parts.append(cmd_b)
+    if cid_b is not None:
+        parts.append(cid_b)
+    if seq_b is not None:
+        parts.append(seq_b)
+    if rseq_b is not None:
+        parts.append(rseq_b)
+    if worker_b is not None:
+        parts.append(worker_b)
+    if sig_b is not None:
+        parts.append(sig_b)
+    if codec_b is not None:
+        parts.append(codec_b)
+    if len(metas) > 0xFFFF:
+        return None
+    for name, dt, shape, clen in metas:
+        nb = _vstr(name)
+        db = _vstr(dt)
+        if nb is None or db is None or len(shape) > 255:
+            return None
+        for d in shape:
+            if not 0 <= d < (1 << 32):
+                return None
+        parts.append(nb)
+        parts.append(db)
+        parts.append(_B1[len(shape)])
+        parts.extend(_U32.pack(d) for d in shape)
+        parts.append(_U32.pack(clen))
+        est += 11 + len(name) + len(dt) + len(str(clen))
+        est += sum(len(str(d)) + 1 for d in shape)
+    if extra is not None:
+        try:
+            extra_b = json.dumps(extra).encode()
+        except (TypeError, ValueError):
+            return None
+        flags1 |= _BF_EXTRA
+        parts.append(_U32.pack(len(extra_b)))
+        parts.append(extra_b)
+        est += len(extra_b)
+    parts[0] = _BFIX.pack(
+        _BMAGIC, _BVERSION, flags1, flags2, cmd_id, len(metas)
+    )
+    out = b"".join(parts)
+    wire_counters.inc_many({
+        "hdr_frames_bin": 1,
+        "hdr_bytes_saved": max(est - len(out), 0),
+    })
+    return out
+
+
+def _decode_bin_header(raw: memoryview) -> dict[str, Any]:
+    """Decode the binary layout back into the header dict the JSON codec
+    would have produced (``arrays`` included)."""
+    buf = bytes(raw)
+    magic, version, flags1, flags2, cmd_id, narrays = _BFIX.unpack_from(buf, 0)
+    if version != _BVERSION:
+        raise ValueError(f"unsupported binary header version {version}")
+    off = _BFIX.size
+    h: dict[str, Any] = {}
+    if flags1 & _BF_CMD_STR:
+        n = buf[off]
+        h["cmd"] = buf[off + 1 : off + 1 + n].decode()
+        off += 1 + n
+    elif cmd_id:
+        # a cmd id appended by a NEWER peer must degrade to an unknown
+        # command (graceful ok:False reply from the handler), not a
+        # KeyError that kills the serving thread
+        h["cmd"] = _CMD_NAMES.get(cmd_id) or f"unknown_cmd_{cmd_id}"
+    if flags1 & _BF_CID:
+        n = buf[off]
+        h["_cid"] = buf[off + 1 : off + 1 + n].decode()
+        off += 1 + n
+    if flags1 & _BF_SEQ:
+        if buf[off] == 0:
+            h["_seq"] = _I64.unpack_from(buf, off + 1)[0]
+            off += 9
+        else:
+            n = buf[off + 1]
+            h["_seq"] = buf[off + 2 : off + 2 + n].decode()
+            off += 2 + n
+    if flags1 & _BF_RSEQ:
+        if buf[off] == 0:
+            h["_rseq"] = _I64.unpack_from(buf, off + 1)[0]
+            off += 9
+        else:
+            n = buf[off + 1]
+            h["_rseq"] = buf[off + 2 : off + 2 + n].decode()
+            off += 2 + n
+    if flags2 & _BF2_WORKER:
+        h["worker"] = _I32.unpack_from(buf, off)[0]
+        off += 4
+    if flags2 & _BF2_SIG:
+        n = buf[off]
+        h["sig"] = buf[off + 1 : off + 1 + n].decode()
+        off += 1 + n
+    if flags2 & _BF2_CODEC:
+        h["codec"] = buf[off]
+        off += 1
+    if flags1 & _BF_OK_TRUE:
+        h["ok"] = True
+    elif flags1 & _BF_OK_FALSE:
+        h["ok"] = False
+    if flags1 & _BF_ZIP:
+        h["zip"] = True
+    if flags2 & _BF2_NEED_KEYS:
+        h["need_keys"] = True
+    if flags2 & _BF2_TRANSIENT:
+        h["_transient"] = True
+    metas = []
+    for _ in range(narrays):
+        n = buf[off]
+        name = buf[off + 1 : off + 1 + n].decode()
+        off += 1 + n
+        n = buf[off]
+        dt = buf[off + 1 : off + 1 + n].decode()
+        off += 1 + n
+        ndim = buf[off]
+        off += 1
+        shape = [
+            _U32.unpack_from(buf, off + 4 * i)[0] for i in range(ndim)
+        ]
+        off += 4 * ndim
+        clen = _U32.unpack_from(buf, off)[0]
+        off += 4
+        metas.append([name, dt, shape, clen])
+    if flags1 & _BF_EXTRA:
+        n = _U32.unpack_from(buf, off)[0]
+        off += 4
+        h.update(json.loads(buf[off : off + n]))
+        off += n
+    h["arrays"] = metas
+    return h
+
+
+#: control-plane commands that ride the HIGH priority lane: they must
+#: never queue behind a multi-MiB pull reply sharing the connection
+#: (heartbeats read as death, the SSP clock stalls every worker).
+#: NOT ``shutdown``: promoting it in the client writer's lane sort would
+#: reorder it AHEAD of still-queued pushes on the same connection — the
+#: server would stop before applying them.
+_PRIO_CMDS = frozenset({
+    "beat", "barrier", "register", "nodes", "dead", "recovered", "stats",
+    "ssp_init", "ssp_wait", "ssp_finish", "ssp_retire",
+    "ssp_progress", "workload_fetch", "workload_finish", "workload_stats",
+    "workload_reassign",
+})
+
+
 def _send_gather(sock, bufs: list) -> None:
     """Gather-write a frame's buffers with one-or-few ``sendmsg`` calls —
     the zero-copy half of send_frame. Transports without sendmsg (test
@@ -194,7 +493,8 @@ def _send_gather(sock, bufs: list) -> None:
 
 
 def build_frame(
-    header: dict[str, Any], arrays: Arrays | None = None
+    header: dict[str, Any], arrays: Arrays | None = None,
+    bin_hdr: bool = False,
 ) -> tuple[list, int]:
     """Encode one framed message as a list of gather buffers (length word,
     header bytes, then each array's memoryview — no tobytes/join copies)
@@ -203,7 +503,9 @@ def build_frame(
     client's flusher batches a window of small frames into a single
     sendmsg). With ``zip`` in the header each eligible array is
     compressed only when the adaptive probe says it wins (meta entry:
-    compressed length, 0 = raw)."""
+    compressed length, 0 = raw). ``bin_hdr`` uses the binary header
+    codec — callers must only pass True once the peer negotiated it
+    (a field the fixed layout can't carry falls back to JSON silently)."""
     arrays = arrays or {}
     metas = []
     bufs: list = []
@@ -222,9 +524,11 @@ def build_frame(
         metas.append([name, a.dtype.str, list(a.shape), clen])
         bufs.append(chunk)
         plen += len(chunk)
-    h = dict(header)
-    h["arrays"] = metas
-    hb = json.dumps(h).encode()
+    hb = _encode_bin_header(header, metas) if bin_hdr else None
+    if hb is None:
+        h = dict(header)
+        h["arrays"] = metas
+        hb = json.dumps(h).encode()
     nbytes = _LEN.size + len(hb) + plen
     # frame-layer byte accounting: EVERY framed message — coordinator and
     # control traffic included — lands in the process-global counters, so
@@ -244,16 +548,24 @@ def send_frame(
     return nbytes
 
 
-def recv_frame_sized(
+def recv_frame_ex(
     sock: socket.socket,
-) -> tuple[dict[str, Any], Arrays, int]:
-    """recv_frame plus the frame's wire size (for traffic counters).
+) -> tuple[dict[str, Any], Arrays, int, bool]:
+    """recv_frame plus the frame's wire size (for traffic counters) and
+    whether the header arrived in the binary codec (the receiver's half
+    of per-connection codec negotiation — the first header byte is the
+    sniff: ``{`` is JSON, ``_BMAGIC`` is binary).
 
     Raw array chunks are returned as ``np.frombuffer`` views of the single
     preallocated receive buffer — zero copies on the landing path;
     compressed chunks (meta compressed_len > 0) decompress per array."""
     hlen, plen = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    header = json.loads(_recv_exact(sock, hlen).tobytes())
+    hraw = _recv_exact(sock, hlen)
+    was_bin = hlen > 0 and hraw[0] == _BMAGIC
+    if was_bin:
+        header = _decode_bin_header(hraw)
+    else:
+        header = json.loads(hraw.tobytes())
     payload = _recv_exact(sock, plen) if plen else memoryview(b"")
     nbytes = _LEN.size + hlen + plen
     wire_counters.inc("wire_bytes_in", nbytes)  # frame layer (see send_frame)
@@ -271,12 +583,34 @@ def recv_frame_sized(
                 payload, dtype=dt, count=n, offset=off
             ).reshape(shape)
             off += n * dt.itemsize
+    return header, arrays, nbytes, was_bin
+
+
+def recv_frame_sized(
+    sock: socket.socket,
+) -> tuple[dict[str, Any], Arrays, int]:
+    header, arrays, nbytes, _ = recv_frame_ex(sock)
     return header, arrays, nbytes
 
 
 def recv_frame(sock: socket.socket) -> tuple[dict[str, Any], Arrays]:
-    header, arrays, _ = recv_frame_sized(sock)
+    header, arrays, _, _ = recv_frame_ex(sock)
     return header, arrays
+
+
+class DeferredReply:
+    """Handler return marker for a reply that is not ready yet: the
+    ``future`` resolves to ``(rep_header, rep_arrays)`` later (the shard
+    server's batched apply engine acks a push only once its batch
+    applied). The serving connection thread keeps draining buffered
+    requests — pulls keep flowing past queued pushes — and settles every
+    deferred reply before it would block on the socket, so 'reply sent'
+    still means 'side effect durable'."""
+
+    __slots__ = ("future",)
+
+    def __init__(self, future: Future):
+        self.future = future
 
 
 class _DedupEntry:
@@ -323,8 +657,19 @@ class RpcServer:
         idempotent_cmds: frozenset[str] = frozenset(),
         expose_identity: bool = False,
         blocking_cmds: frozenset[str] = frozenset(),
+        prio_cmds: frozenset[str] = _PRIO_CMDS,
+        lane_hi: int = 4,
+        lane_lo: int = 16,
+        withheld_max_bytes: int = 8 << 20,
     ):
         self._handler = handler
+        # reply priority lanes: replies to prio_cmds flush first (and at a
+        # tighter withheld bound) so a control ack sharing the connection
+        # never queues behind a multi-MiB coalesced pull reply
+        self._prio_cmds = prio_cmds
+        self._lane_hi = max(1, int(lane_hi))
+        self._lane_lo = max(1, int(lane_lo))
+        self._withheld_max_bytes = int(withheld_max_bytes)
         # commands whose handler may PARK the connection thread (barrier,
         # ssp_wait, blocking kv_get): coalesced replies must flush before
         # dispatching one, or earlier requests' replies would be withheld
@@ -372,29 +717,96 @@ class RpcServer:
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         reader = FrameReader(conn)  # this thread owns the receive side
-        # reply coalescing: while further requests sit in the read buffer
-        # (a pipelined burst), replies accumulate and flush as ONE gather
-        # write; with nothing buffered the reply flushes immediately, so
-        # lockstep latency is untouched
-        out_bufs: list = []
-        out_n = 0
-        out_frames = 0
+        # reply coalescing, now in TWO priority lanes: while further
+        # requests sit in the read buffer (a pipelined burst), replies
+        # accumulate and flush as ONE gather write with the hi (control)
+        # lane ahead of the lo (bulk) lane; with nothing buffered the
+        # reply flushes immediately, so lockstep latency is untouched.
+        # Reordering replies across lanes is safe: pipelined clients
+        # match replies by the _rseq echo, and raw no-seq clients only
+        # ever see the in-order single-reply path (both lanes flush
+        # together, hi first, and a raw client gets one reply per
+        # lockstep request anyway).
+        hi_bufs: list = []
+        lo_bufs: list = []
+        hi_n = lo_n = 0
+        hi_frames = lo_frames = 0
+        # deferred replies (batched apply): settled before this thread
+        # blocks on the socket, so an acked push is always applied;
+        # entries are (seq, deferred, cmd, t_svc, bin_hdr, advert)
+        deferred: list[tuple[Any, DeferredReply, str, float, bool, bool]] = []
 
-        def queue_reply(rep: dict[str, Any], rep_arrays: Arrays | None) -> None:
-            nonlocal out_n, out_frames
-            fb, n = build_frame(rep, rep_arrays)
-            out_bufs.extend(fb)
-            out_n += n
-            out_frames += 1
+        def queue_reply(
+            rep: dict[str, Any], rep_arrays: Arrays | None,
+            hi: bool = False, bin_hdr: bool = False,
+        ) -> None:
+            nonlocal hi_n, lo_n, hi_frames, lo_frames
+            fb, n = build_frame(rep, rep_arrays, bin_hdr=bin_hdr)
+            if hi:
+                hi_bufs.extend(fb)
+                hi_n += n
+                hi_frames += 1
+            else:
+                lo_bufs.extend(fb)
+                lo_n += n
+                lo_frames += 1
+            # reply-coalescing memory gauge: the deepest withheld-bytes
+            # point any connection reached (merged cluster-wide as a max)
+            wire_counters.observe_max("wire_withheld_bytes_peak", hi_n + lo_n)
 
         def flush_replies() -> None:
-            nonlocal out_bufs, out_n, out_frames
-            if not out_bufs:
+            nonlocal hi_bufs, lo_bufs, hi_n, lo_n, hi_frames, lo_frames
+            if not hi_bufs and not lo_bufs:
                 return
-            _send_gather(conn, out_bufs)
+            _send_gather(conn, hi_bufs + lo_bufs)  # control lane first
             with self._counter_lock:
-                self.bytes_out += out_n
-            out_bufs, out_n, out_frames = [], 0, 0
+                self.bytes_out += hi_n + lo_n
+            hi_bufs, lo_bufs = [], []
+            hi_n = lo_n = 0
+            hi_frames = lo_frames = 0
+
+        def decorated(
+            rep: dict[str, Any], seq_d: Any, adv_d: bool
+        ) -> dict[str, Any]:
+            """One copy of the reply decoration: echo the request's seq
+            (``_rseq``) and/or ack the codec advert (``_bh``) on a COPY —
+            ``rep`` may be a shared reply-cache dict."""
+            if seq_d is None and not adv_d:
+                return rep
+            rep = dict(rep)
+            if seq_d is not None:
+                rep["_rseq"] = seq_d
+            if adv_d:
+                rep["_bh"] = 1
+            return rep
+
+        def settle_deferred() -> None:
+            """Resolve every pending deferred reply into the lo lane (in
+            arrival order). Called before any point where this thread
+            would block on the socket or sever the connection."""
+            for seq_d, d, cmd_d, t_d, bin_d, adv_d in deferred:
+                try:
+                    rep_d, arrays_d = d.future.result()
+                except ConnectionError:
+                    # the apply engine is stopping under this push: a
+                    # clean ok:False reply would read as a PERMANENT
+                    # remote error and the client would never resend —
+                    # sever the connection instead, so the transport heal
+                    # retries against the relaunched server (the durable
+                    # ledger dedups any half-applied overlap)
+                    deferred.clear()
+                    flush_replies()
+                    raise
+                except Exception as e:  # noqa: BLE001 — surfaced remotely
+                    rep_d, arrays_d = {"ok": False, "error": repr(e)}, {}
+                latency_histograms.observe(
+                    f"server.{cmd_d}", time.perf_counter() - t_d
+                )
+                queue_reply(
+                    decorated(rep_d, seq_d, adv_d), arrays_d,
+                    hi=False, bin_hdr=bin_d,
+                )
+            deferred.clear()
         with self._counter_lock:
             self._conns.add(conn)
         # register-then-check pairs with stop()'s set-then-sever: a conn
@@ -410,7 +822,7 @@ class RpcServer:
             return
         try:
             while True:
-                header, arrays, nbytes = recv_frame_sized(reader)
+                header, arrays, nbytes, was_bin = recv_frame_ex(reader)
                 with self._counter_lock:
                     self.bytes_in += nbytes
                     self.frames_in += 1
@@ -425,6 +837,7 @@ class RpcServer:
                     # still go out (as they did pre-coalescing), or a
                     # periodic drop would livelock a pipelined client —
                     # every resend round re-killed before any reply lands
+                    settle_deferred()
                     flush_replies()
                     return  # request lost before it applied; conn closed below
                 if fault is not None and fault.action == "delay":
@@ -432,6 +845,11 @@ class RpcServer:
                 cid = header.pop("_cid", None)
                 seq = header.pop("_seq", None)
                 tctx = header.pop("_trace", None)  # caller's span identity
+                # codec negotiation: the reply rides the request's codec
+                # (echo — a binary request proves the peer decodes binary);
+                # a JSON request advertising _bh gets _bh acked back so the
+                # client knows it may switch this connection to binary
+                advert = bool(header.pop("_bh", False)) and not was_bin
                 cmd_name = header.get("cmd", "?")
                 # copy BEFORE dispatch: handlers mutate the header (pop cmd)
                 dup_header = (
@@ -439,7 +857,10 @@ class RpcServer:
                     if fault is not None and fault.action == "duplicate"
                     else None
                 )
-                if out_bufs and cmd_name in self._blocking_cmds:
+                if (hi_bufs or lo_bufs or deferred) and (
+                    cmd_name in self._blocking_cmds
+                ):
+                    settle_deferred()
                     flush_replies()  # see blocking_cmds in __init__
                 t_svc = time.perf_counter()
                 try:
@@ -457,15 +878,17 @@ class RpcServer:
                             # the same frame delivered twice: without dedup
                             # this double-applies (copy's reply discarded)
                             self._dispatch(cid, seq, dup_header, arrays)
-                    latency_histograms.observe(
-                        f"server.{cmd_name}", time.perf_counter() - t_svc
-                    )
+                    if not isinstance(rep, DeferredReply):
+                        latency_histograms.observe(
+                            f"server.{cmd_name}", time.perf_counter() - t_svc
+                        )
                 except RpcServer.Shutdown:
                     try:
-                        ack: dict[str, Any] = {"ok": True}
-                        if seq is not None:
-                            ack["_rseq"] = seq
-                        queue_reply(ack, None)
+                        settle_deferred()
+                        queue_reply(
+                            decorated({"ok": True}, seq, advert), None,
+                            hi=True, bin_hdr=was_bin,
+                        )
                         flush_replies()
                     finally:
                         # stop() even when the ack send fails: the reply
@@ -477,22 +900,46 @@ class RpcServer:
                     return
                 if fault is not None and fault.action == "disconnect":
                     # lose THIS reply only (see the drop branch): earlier
-                    # withheld replies flush before the conn severs
+                    # withheld replies flush before the conn severs. A
+                    # deferred apply is still settled first — 'disconnect'
+                    # loses the reply, never the side effect's durability.
+                    if isinstance(rep, DeferredReply):
+                        try:
+                            rep.future.result()
+                        except Exception:  # noqa: BLE001 — reply is lost
+                            pass
+                    settle_deferred()
                     flush_replies()
                     return  # applied, but the reply is lost; conn closed below
-                if seq is not None:
-                    # echo the request's sequence number so a pipelined
-                    # client matches this reply to the right in-flight
-                    # future (copy: rep may be a shared reply-cache dict)
-                    rep = {**rep, "_rseq": seq}
-                queue_reply(rep, rep_arrays)
-                # flush when input drains — or at a bound: withheld pull
-                # replies pin their row arrays, so a deep client window
-                # must not accumulate them without limit
-                if not reader.buffered() or out_frames >= 16:
+                if isinstance(rep, DeferredReply):
+                    deferred.append(
+                        (seq, rep, cmd_name, t_svc, was_bin, advert)
+                    )
+                    if len(deferred) >= 64:  # bound parked futures
+                        settle_deferred()
+                else:
+                    # the seq echo lets a pipelined client match this
+                    # reply to the right in-flight future
+                    queue_reply(
+                        decorated(rep, seq, advert), rep_arrays,
+                        hi=cmd_name in self._prio_cmds, bin_hdr=was_bin,
+                    )
+                # flush when input drains — or at a lane bound: withheld
+                # pull replies pin their row arrays (frames AND bytes are
+                # bounded), and control acks flush at the tighter hi bound
+                if not reader.buffered():
+                    settle_deferred()
+                    flush_replies()
+                elif (
+                    lo_frames >= self._lane_lo
+                    or hi_frames >= self._lane_hi
+                    or hi_n + lo_n >= self._withheld_max_bytes
+                ):
                     flush_replies()
         except (ConnectionError, OSError):
             return  # client went away; its requests died with it
+        except (ValueError, KeyError, IndexError, struct.error, zlib.error):
+            return  # undecodable frame: framing lost, sever the conn
         finally:
             try:
                 conn.close()
@@ -538,7 +985,7 @@ class RpcServer:
             ent.rep, ent.arrays = {"ok": True}, {}
             ent.event.set()
             raise
-        if rep.get("_transient"):
+        if not isinstance(rep, DeferredReply) and rep.get("_transient"):
             # did-not-commit reply (e.g. the shard server's need_keys
             # bounce): nothing was applied, so a later delivery of this
             # SAME (cid, seq) must re-run the handler, not replay this
@@ -631,6 +1078,9 @@ class RpcClient:
     only bounds time spent *retrying after a failure*; a healthy blocking
     call (barrier, ssp_wait) may park indefinitely as before."""
 
+    #: completions between window adaptations (adaptive_window)
+    _ADAPT_EVERY = 64
+
     def __init__(
         self,
         address: str,
@@ -640,18 +1090,40 @@ class RpcClient:
         cid: str | None = None,
         start_seq: int = 0,
         window: int = 8,
+        hdr_codec: str = "bin",
+        adaptive_window: bool = False,
     ):
         """``cid``/``start_seq`` transfer a logical client identity into a
         rebuilt connection (ServerHandle recovery): the server's dedup
         state is keyed by cid, so a resend after the rebuild is only
         recognized if the identity survives. ``start_seq`` must clear the
         old client's counter or fresh requests would collide with (and be
-        swallowed by) cached replies of old sequence numbers."""
+        swallowed by) cached replies of old sequence numbers.
+
+        ``hdr_codec="bin"`` prefers the binary header codec: requests go
+        JSON carrying ``_bh: 1`` until a reply proves the peer decodes
+        binary, then this connection switches (re-negotiated per
+        reconnect, so a downgraded replacement server degrades to JSON).
+
+        ``adaptive_window=True`` derives the EFFECTIVE in-flight window
+        from this client's completion-latency histogram: halve on a p99
+        blowup, creep back up while latency is healthy and the window is
+        saturated. ``window`` stays the hard ceiling."""
         self._address = address
         self._cid = cid or uuid.uuid4().hex[:16]
         self._next_seq = start_seq
         self._reconnect_timeout_s = reconnect_timeout_s
         self._window = max(1, int(window))
+        self._hdr_bin = hdr_codec == "bin"
+        self._bin_gen_ok = False  # this connection negotiated binary
+        self._rseq_gen_ok = False  # peer echoes _rseq on this connection
+        self._adaptive = bool(adaptive_window)
+        self._eff_window = self._window
+        self._lat_hist = Histogram()  # this client's own completions
+        self._adapt_last: dict[str, Any] | None = None
+        self._adapt_n = 0
+        self._adapt_peak = 0
+        self._ema_p50 = 0.0
         self._rng = random.Random()  # backoff jitter: no determinism contract
         self._cv = threading.Condition()  # guards all connection/pending state
         # serializes actual socket writes (inline fast path vs the writer
@@ -693,6 +1165,8 @@ class RpcClient:
         connection generation and start the generation's reader and
         writer threads."""
         self._gen += 1
+        self._bin_gen_ok = False  # codec re-negotiates per connection
+        self._rseq_gen_ok = False  # until the peer proves it echoes seqs
         self._sock = sock
         threading.Thread(
             target=self._read_loop, args=(sock, self._gen), daemon=True
@@ -707,16 +1181,32 @@ class RpcClient:
         reader = FrameReader(sock)  # this thread owns the receive side
         while True:
             try:
-                rep, arrays, nbytes = recv_frame_sized(reader)
+                rep, arrays, nbytes, was_bin = recv_frame_ex(reader)
             except (ConnectionError, OSError):
                 break
+            except (ValueError, KeyError, IndexError, struct.error,
+                    zlib.error):
+                # undecodable frame (corrupt stream or compressed chunk,
+                # incompatible codec version): framing is lost — treat
+                # the connection as dead so the heal reconnects and
+                # resends the window, instead of stranding every pending
+                # future forever
+                break
             p: _PendingCall | None = None
+            bin_ok = was_bin or bool(rep.pop("_bh", False))
             with self._cv:
                 if self._closed or self._gen != gen:
                     return  # stale reader: a heal already replaced this conn
+                if bin_ok and self._hdr_bin and not self._bin_gen_ok:
+                    # the peer proved it decodes binary (replied binary,
+                    # or acked our _bh advert): switch this connection
+                    self._bin_gen_ok = True
                 self.bytes_in += nbytes
                 seq = rep.pop("_rseq", None)
                 if seq is not None:
+                    # the peer echoes sequence numbers: reply matching is
+                    # order-independent, so the writer may prioritize
+                    self._rseq_gen_ok = True
                     p = self._pending.pop(seq, None)  # None: dup of a resend
                 elif self._pending:
                     # reply without an echo (legacy server): per-connection
@@ -730,13 +1220,66 @@ class RpcClient:
     def _complete(self, p: _PendingCall, rep: dict[str, Any], arrays: Arrays) -> None:
         # client-observed latency: queueing + wire + service + any
         # transparent retries/reconnects this call absorbed
-        latency_histograms.observe(f"client.{p.cmd}", time.perf_counter() - p.t0)
+        dt = time.perf_counter() - p.t0
+        latency_histograms.observe(f"client.{p.cmd}", dt)
+        if self._adaptive:
+            self._lat_hist.observe(dt)
+            self._adapt_n += 1
+            if self._adapt_n >= self._ADAPT_EVERY:
+                self._adapt_n = 0
+                self._maybe_adapt()
         if not rep.get("ok", True):
             p.future.set_exception(
                 RuntimeError(f"{p.cmd} failed remotely: {rep.get('error')}")
             )
         else:
             p.future.set_result((rep, arrays))
+
+    def _maybe_adapt(self) -> None:
+        """Adaptive window policy over the last ``_ADAPT_EVERY``
+        completions' latency-histogram DELTA (the PR-2 log2 buckets —
+        exact under subtraction): a p99 blowup past 4x the p50 EMA halves
+        the effective window (queueing delay is the symptom of a window
+        the server can't drain); a healthy p99 while the window was
+        actually saturated grows it back one step toward the ceiling."""
+        snap = self._lat_hist.snapshot()
+        last, self._adapt_last = self._adapt_last, snap
+        if last is None:
+            return
+        delta = {
+            "count": snap["count"] - last.get("count", 0),
+            "buckets": {
+                k: c - last.get("buckets", {}).get(k, 0)
+                for k, c in snap.get("buckets", {}).items()
+            },
+        }
+        if delta["count"] <= 0:
+            return
+        p50 = hist_percentile(delta, 0.5)
+        p99 = hist_percentile(delta, 0.99)
+        if self._ema_p50 == 0.0:
+            self._ema_p50 = p50
+        with self._cv:
+            peak, self._adapt_peak = self._adapt_peak, 0
+            if p99 > 4 * max(self._ema_p50, 1e-6) and self._eff_window > 1:
+                self._eff_window = max(1, self._eff_window // 2)
+                wire_counters.inc("wire_window_shrinks")
+            elif (
+                self._eff_window < self._window
+                and p99 <= 2 * max(self._ema_p50, 1e-6)
+                and peak >= self._eff_window
+            ):
+                self._eff_window += 1
+                wire_counters.inc("wire_window_grows")
+                self._cv.notify_all()  # a waiter may now fit the window
+        self._ema_p50 = 0.8 * self._ema_p50 + 0.2 * p50
+
+    @property
+    def effective_window(self) -> int:
+        """Current in-flight bound (== the configured window unless
+        adaptive_window is shaping it)."""
+        with self._cv:
+            return self._eff_window
 
     def _conn_died(self, sock: socket.socket, gen: int) -> None:
         """A connection failed under its reader (or a sender): tear it
@@ -903,7 +1446,7 @@ class RpcClient:
                 if not _urgent:
                     self._cv.wait_for(
                         lambda: self._closed
-                        or len(self._pending) < self._window
+                        or len(self._pending) < self._eff_window
                     )
                 if self._closed:
                     raise ConnectionError(
@@ -913,10 +1456,16 @@ class RpcClient:
                     _seq = self._next_seq
                     self._next_seq += 1
                 header = {"cmd": cmd, "_cid": self._cid, "_seq": _seq, **fields}
+                if self._hdr_bin and not self._bin_gen_ok:
+                    # codec advert: ask the peer to confirm binary headers
+                    # (ignored by old servers, acked by new ones)
+                    header["_bh"] = 1
                 if ctx is not None:
                     header["_trace"] = ctx
                 p = _PendingCall(_seq, cmd, header, arrays, _retry)
                 self._pending[_seq] = p
+                if len(self._pending) > self._adapt_peak:
+                    self._adapt_peak = len(self._pending)
                 wire_counters.observe_max(
                     "rpc_inflight_peak", len(self._pending)
                 )
@@ -938,12 +1487,13 @@ class RpcClient:
                         for q in self._pending.values()
                     )
                 )
+                use_bin = self._hdr_bin and self._bin_gen_ok
                 if inline:
                     p.sent = True
                 else:
                     self._cv.notify_all()  # wake the connection's writer
             if inline:
-                bufs, n = build_frame(p.header, p.arrays)
+                bufs, n = build_frame(p.header, p.arrays, bin_hdr=use_bin)
                 try:
                     with self._send_lock:
                         _send_gather(sock, bufs)
@@ -1004,10 +1554,20 @@ class RpcClient:
                     self._cv.wait()
                 for q in batch:
                     q.sent = True  # claimed; heal ignores claims on resend
+                use_bin = self._hdr_bin and self._bin_gen_ok
+                prio_ok = self._rseq_gen_ok
+            # two-lane writer: control frames (heartbeat, ssp clock,
+            # workload fetch) lead the coalesced gather so they never
+            # queue behind a multi-MiB push sharing this connection
+            # (stable sort: FIFO preserved within each lane). ONLY once
+            # the peer has echoed an _rseq: a legacy no-echo server is
+            # matched by reply ORDER, which reordering would corrupt.
+            if prio_ok:
+                batch.sort(key=lambda q: q.cmd not in _PRIO_CMDS)
             bufs: list = []
             total = 0
             for q in batch:
-                fb, n = build_frame(q.header, q.arrays)
+                fb, n = build_frame(q.header, q.arrays, bin_hdr=use_bin)
                 bufs.extend(fb)
                 total += n
             if len(batch) > 1:

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import queue as queue_mod
 import threading
 import time
 from collections import OrderedDict
@@ -35,20 +36,26 @@ from typing import Any
 
 import numpy as np
 
+from parameter_server_tpu.kv import store as kv_store
 from parameter_server_tpu.kv.updaters import Updater
 from parameter_server_tpu.parallel.chaos import PLAN_ENV, SEED_ENV, FaultPlan
 from parameter_server_tpu.parallel.control import (
     Arrays,
     ControlClient,
     Coordinator,
+    DeferredReply,
     RpcClient,
     RpcServer,
 )
 from parameter_server_tpu.utils import trace
-from parameter_server_tpu.utils.config import PSConfig
+from parameter_server_tpu.utils.config import PSConfig, ServerConfig
 from parameter_server_tpu.utils.heartbeat import HeartbeatReporter, host_stats
 from parameter_server_tpu.utils.keyrange import KeyRange
-from parameter_server_tpu.utils.metrics import telemetry_snapshot, wire_counters
+from parameter_server_tpu.utils.metrics import (
+    observe_scalar,
+    telemetry_snapshot,
+    wire_counters,
+)
 
 
 def _plan_from_cfg(cfg: PSConfig) -> FaultPlan | None:
@@ -106,6 +113,27 @@ class _LruSigs:
             return len(self._d)
 
 
+class _QueuedPush:
+    """One decoded push waiting in the apply queue: keys + decoded grad,
+    its durable dedup identity, the caller's trace context (so the apply
+    still joins the client's trace across the thread hop), and the Future
+    the deferred RPC reply resolves from."""
+
+    __slots__ = ("keys", "grad", "cid", "seq", "tctx", "future")
+
+    def __init__(
+        self, keys: np.ndarray, grad: np.ndarray,
+        cid: str | None, seq: str | None,
+        tctx: dict | None = None,
+    ):
+        self.keys = keys
+        self.grad = grad
+        self.cid = cid
+        self.seq = seq
+        self.tctx = tctx
+        self.future: Future = Future()
+
+
 class ShardServer:
     """One server process: updater state over its key range, served via RPC.
 
@@ -113,6 +141,24 @@ class ShardServer:
     process's default JAX device (CPU in the simulated harness, the local
     chip in a real multi-slice run) and updates run eagerly — this tier is
     wire-bound, not compute-bound.
+
+    Batched apply engine (ref: the paper's servers applying *aggregated*
+    updates over touched keys only): pushes don't apply on their serving
+    connection threads anymore. Each decoded push lands in a bounded
+    queue; ONE dedicated apply thread drains whatever has concurrently
+    arrived (up to ``[server] max_batch``), pre-aggregates duplicate keys
+    across clients (``kv.store.coalesce_pushes`` — the store's
+    exactly-once invariant for nonlinear updaters), applies the updater
+    ONCE over the union of touched rows, records the whole batch in the
+    durable push ledger atomically with the state it produced, and
+    publishes the new state as a single reference swap. Pulls and dumps
+    serve from that published snapshot WITHOUT the write lock (RCU: the
+    state dict is never mutated after publish, so a reader sees the
+    pre- or post-batch table, never a torn mix); SSP bounded-delay
+    semantics are unchanged — staleness was always bounded by the clock,
+    not by this lock. ``[server] apply_queue = 0`` disables the engine
+    (pushes apply inline under the lock — the serial pre-engine
+    discipline, kept as the bench baseline).
     """
 
     def __init__(
@@ -124,15 +170,25 @@ class ShardServer:
         port: int = 0,
         advertise_host: str = "",
         fault_plan: FaultPlan | None = None,
+        server_cfg: ServerConfig | None = None,
     ):
         import jax.numpy as jnp
 
+        scfg = server_cfg or ServerConfig()
         self.updater = updater
         self.range = key_range
         self.state = updater.init(key_range.size, vdim)
         self._jnp = jnp
         self._key_cache = _LruSigs()  # (worker, sig) -> key array
         self._lock = threading.Lock()
+        self._max_batch = max(1, int(scfg.max_batch))
+        self._apply_q: queue_mod.Queue[_QueuedPush] | None = (
+            queue_mod.Queue(maxsize=int(scfg.apply_queue))
+            if scfg.apply_queue > 0
+            else None
+        )
+        self._apply_open = self._apply_q is not None
+        self._apply_thread: threading.Thread | None = None
         self._ctr_lock = threading.Lock()  # counters bumped by conn threads
         self._ckpt_write_lock = threading.Lock()  # one dump writer at a time
         self._ckpt_thread: threading.Thread | None = None
@@ -146,7 +202,7 @@ class ShardServer:
         self._applied_push: OrderedDict[str, OrderedDict[str, None]] = OrderedDict()
         self.counters = {
             "pulls": 0, "pushes": 0, "cache_hits": 0, "need_keys": 0,
-            "push_replays": 0,
+            "push_replays": 0, "apply_batches": 0, "push_coalesced": 0,
         }
         if host in ("0.0.0.0", "::", "") and not advertise_host:
             raise ValueError(
@@ -160,6 +216,9 @@ class ShardServer:
             # cache keeps their row-payload replies from being pinned
             idempotent_cmds=frozenset({"pull", "dump", "stats"}),
             expose_identity=True,  # push branch keeps the durable ledger
+            lane_hi=scfg.lane_hi,
+            lane_lo=scfg.lane_lo,
+            withheld_max_bytes=scfg.withheld_max_mb << 20,
         )
         # bind and advertise may differ: bind 0.0.0.0 to accept remote
         # workers, advertise a routable hostname via the coordinator KV
@@ -192,13 +251,209 @@ class ShardServer:
             self.counters[name] += 1
 
     def start(self) -> "ShardServer":
+        self._start_apply_thread()
         self.server.start()
         return self
 
     def serve_forever(self) -> None:
+        self._start_apply_thread()
         self.server.start()
         while not self.server._stop.wait(0.2):
             pass
+
+    # -- batched apply engine ---------------------------------------------
+
+    def _start_apply_thread(self) -> None:
+        if self._apply_q is None or self._apply_thread is not None:
+            return
+        self._apply_thread = threading.Thread(
+            target=self._apply_loop, daemon=True, name="ps-apply"
+        )
+        self._apply_thread.start()
+
+    @staticmethod
+    def _fail_stopping(item: _QueuedPush) -> None:
+        """Fail a push stranded by engine shutdown with ConnectionError —
+        the RPC layer severs the connection instead of sending a clean
+        error reply, so the client's transport heal RESENDS the push
+        (against the relaunched server, deduped by the durable ledger)
+        rather than hard-failing the worker on a transient condition."""
+        if not item.future.done():
+            try:
+                item.future.set_exception(ConnectionError(
+                    "shard server stopping; push not applied"
+                ))
+            except Exception:  # noqa: BLE001 — the drain beat us to it
+                pass
+
+    def _enqueue_push(self, item: _QueuedPush) -> None:
+        """Admit one decoded push into the apply queue (backpressure: a
+        full queue parks this serving thread until the engine drains —
+        which also withholds this connection's coalesced replies for the
+        drain's duration, bounded by apply_queue/max_batch batch applies;
+        settling deferred acks before every push instead would serialize
+        the very pipeline the engine exists to batch). Never raises — a
+        shutdown race resolves the item's future with ConnectionError
+        instead (see _fail_stopping)."""
+        q = self._apply_q
+        assert q is not None
+        observe_scalar("server.apply_queue.n", q.qsize() + 1)
+        trace.counter("server.apply_queue_depth", q.qsize() + 1)
+        while True:
+            if not self._apply_open:
+                self._fail_stopping(item)
+                return
+            try:
+                q.put(item, timeout=0.05)
+            except queue_mod.Full:
+                continue
+            if not self._apply_open:
+                # raced with engine shutdown: the grace drain may already
+                # have finished, leaving this item parked in a queue
+                # nobody drains — fail it here (drain may also have)
+                self._fail_stopping(item)
+            return
+
+    def _apply_loop(self) -> None:
+        """The apply thread: drain whatever pushes have concurrently
+        arrived (bounded by max_batch) and apply them as ONE coalesced
+        update. Exits once the server stops, failing stragglers so no
+        serving thread parks on an unresolvable deferred reply (their
+        clients resend to the relaunched server; the ledger dedups)."""
+        q = self._apply_q
+        assert q is not None
+        stop = self.server._stop
+        while not stop.is_set():
+            try:
+                first = q.get(timeout=0.2)
+            except queue_mod.Empty:
+                continue
+            batch = [first]
+            while len(batch) < self._max_batch:
+                try:
+                    batch.append(q.get_nowait())
+                except queue_mod.Empty:
+                    break
+            try:
+                self._apply_batch(batch)
+            except Exception:  # noqa: BLE001 — isolate the offender
+                # one malformed push (bad grad shape, poison payload)
+                # must not fail the innocent pushes it happened to
+                # coalesce with — the serial path confined the error to
+                # its own request, so does the retry: each item re-runs
+                # as its own batch and only the offender's future fails
+                for p in batch:
+                    if p.future.done():
+                        continue
+                    try:
+                        self._apply_batch([p])
+                    except Exception as e1:  # noqa: BLE001
+                        if not p.future.done():
+                            p.future.set_exception(e1)
+        self._apply_open = False
+        deadline = time.monotonic() + 0.5  # grace: racing enqueuers land
+        while time.monotonic() < deadline:
+            try:
+                p = q.get_nowait()
+            except queue_mod.Empty:
+                time.sleep(0.05)
+                continue
+            self._fail_stopping(p)
+
+    def _apply_batch(self, batch: list[_QueuedPush]) -> None:
+        """Coalesce and apply one batch: segment-sum duplicate keys across
+        the batch's pushes, ONE updater delta over the union of touched
+        rows, the whole batch recorded in the durable ledger atomically
+        with the state publish (save_state can never snapshot a state
+        that disagrees with its ledger)."""
+        todo: list[_QueuedPush] = []
+        dups: list[_QueuedPush] = []
+        with self._lock:
+            seen: set[tuple[str | None, str | None]] = set()
+            for p in batch:
+                if p.cid is not None:
+                    per = self._applied_push.get(p.cid)
+                    if per is not None and p.seq in per:
+                        # already applied (and ledgered) in a previous
+                        # server life: durably done — ack immediately
+                        self._bump("push_replays")
+                        wire_counters.inc("rpc_dedup_hits")
+                        if not p.future.done():
+                            p.future.set_result(({"ok": True}, {}))
+                        continue
+                    if (p.cid, p.seq) in seen:
+                        # duplicate within THIS batch: its first instance
+                        # has not applied yet, so the ack must WAIT for
+                        # the publish — acking now would break 'acked =>
+                        # durably applied' if the apply then fails
+                        self._bump("push_replays")
+                        wire_counters.inc("rpc_dedup_hits")
+                        dups.append(p)
+                        continue
+                    seen.add((p.cid, p.seq))
+                todo.append(p)
+            if todo:
+                # pad_to_pow2: a coalesced union has a different length
+                # every batch, and each fresh shape re-dispatches the
+                # whole eager updater chain — the pow-2 bucket pins
+                # batches to a handful of compiled shapes (pad rows are
+                # PAD_KEY 0 + zero grad, which every updater maps to a
+                # zero delta per the store invariant)
+                idx, grad = kv_store.coalesce_pushes(
+                    [p.keys for p in todo], [p.grad for p in todo],
+                    pad_to_pow2=True,
+                )
+                with trace.span(
+                    "server.apply_batch", cat="ps",
+                    pushes=len(todo), keys=len(idx),
+                ):
+                    # ONE jitted dispatch for the whole batch (the
+                    # bucketed shapes keep the compile count at ~one per
+                    # pow-2 union size). Deliberately NOT donated: the
+                    # old buffers must stay valid for concurrent RCU
+                    # snapshot readers (pull/dump) until they drop them.
+                    new_state = kv_store.push(
+                        self.updater, self.state,
+                        self._jnp.asarray(idx), self._jnp.asarray(grad),
+                    )
+                    for p in todo:
+                        if p.cid is not None:
+                            self._record_push(p.cid, p.seq)
+                    # RCU publish: ONE reference swap — pull/dump capture
+                    # self.state without the lock and see the pre- or
+                    # post-batch table, never a torn mix
+                    self.state = new_state
+        with self._ctr_lock:
+            self.counters["pushes"] += len(todo)
+            self.counters["apply_batches"] += 1
+            # only genuinely APPLIED pushes count as coalesced — counting
+            # ledger replays/duplicates would inflate the batching win by
+            # exactly the dedup traffic
+            self.counters["push_coalesced"] += max(len(todo) - 1, 0)
+        if len(todo) > 1:
+            wire_counters.inc("push_coalesced", len(todo) - 1)
+        observe_scalar("server.apply_batch.n", len(batch))
+        trace.counter("server.apply_batch_size", len(batch))
+        if trace.enabled():
+            # per-push updater spans re-join each caller's trace across
+            # the thread hop (the PR-2 contract: one logical push is one
+            # trace id, client span -> dispatch span -> updater span)
+            for p in todo:
+                with trace.activate(p.tctx), trace.span(
+                    "server.updater", cat="ps",
+                    keys=len(p.keys), batched=len(todo),
+                ):
+                    pass
+        # dups resolve here too: the publish they waited on has happened
+        # (on an exception above, neither list resolves — the apply loop's
+        # per-item retry re-runs them, and a dup then replays off the
+        # ledger its first instance just wrote)
+        for p in todo + dups:
+            if not p.future.done():  # the shutdown race may fail one first
+                try:
+                    p.future.set_result(({"ok": True}, {}))
+                except Exception:  # noqa: BLE001 — lost the race benignly
+                    pass
 
     # -- checkpoint/restart (ref: each server dumps its own key range;
     # resume = reload the range before continuing) ------------------------
@@ -311,9 +566,14 @@ class ShardServer:
             keys = self._resolve_keys(h, arrays)
             if keys is None:
                 return {"ok": True, "need_keys": True}, {}
-            with self._lock:
-                rows = {k: v[keys] for k, v in self.state.items()}
-                w = np.asarray(self.updater.weights(rows)).reshape(len(keys), -1)
+            # RCU snapshot read: ONE reference capture of the published
+            # state (the apply thread swaps a complete new dict per
+            # batch, never mutates one in place), so this pull sees the
+            # pre- or post-batch table without taking the write lock —
+            # pulls no longer queue behind pushes
+            state = self.state
+            rows = {k: v[keys] for k, v in state.items()}
+            w = np.asarray(self.updater.weights(rows)).reshape(len(keys), -1)
             self._bump("pulls")
             return {"ok": True, "zip": h.get("zip", False)}, {"w": w.ravel()}
         if cmd == "push":
@@ -335,10 +595,32 @@ class ShardServer:
                 # pin this bounce, so the keyed follow-up (same seq) re-runs
                 return {"ok": True, "need_keys": True, "_transient": True}, {}
             g = self._decode_grad(h, arrays).reshape(len(keys), -1)
-            # updater span: the server-side cost of applying this push
-            # (child of the rpc.serve.push dispatch span, which already
-            # carries the client's trace id — the third hop of the
-            # client -> dispatch -> updater chain)
+            if (
+                self._apply_q is not None
+                and self._apply_thread is not None
+                and cid is not None
+            ):
+                # engine path only once start() armed the apply thread: a
+                # handler driven directly (tests, tools) keeps the inline
+                # path instead of deferring onto a thread nobody runs
+                # batched apply engine: enqueue the DECODED push and defer
+                # the reply — the serving thread keeps draining buffered
+                # requests (pulls flow past queued pushes) and the RPC
+                # layer settles this reply once the batch applied, so an
+                # acked push is still a durably recorded one. Raw no-cid
+                # frames keep the inline path: their reply ordering
+                # contract has no seq echo to survive deferral.
+                item = _QueuedPush(
+                    np.asarray(keys), np.asarray(g), cid, seq,
+                    # the dispatch span's identity: the apply thread's
+                    # server.updater span re-joins this push's trace
+                    tctx=trace.wire_context() if trace.enabled() else None,
+                )
+                self._enqueue_push(item)
+                return DeferredReply(item.future), {}
+            # serial path ([server] apply_queue = 0): apply inline under
+            # the write lock — the pre-engine discipline, kept as the
+            # bench baseline and the raw-frame fallback
             with trace.span("server.updater", cat="ps", keys=len(keys)):
                 with self._lock:
                     rows = {k: v[keys] for k, v in self.state.items()}
@@ -352,8 +634,8 @@ class ShardServer:
             self._bump("pushes")
             return {"ok": True}, {}
         if cmd == "dump":
-            with self._lock:
-                w = np.asarray(self.updater.weights(self.state))
+            state = self.state  # RCU snapshot (see pull)
+            w = np.asarray(self.updater.weights(state))
             return {"ok": True, "begin": self.range.begin, "end": self.range.end}, {
                 "w": w
             }
@@ -425,9 +707,13 @@ class ServerHandle:
         # _keyed_call quickly instead of burning the whole handle window
         self._client_window_s = min(3.0, self._reconnect_timeout_s)
         self._pipeline_window = max(1, cfg.wire.window)
+        self._hdr_codec = cfg.wire.hdr_codec
+        self._adaptive_window = cfg.wire.adaptive_window
         self.client = RpcClient(
             address, reconnect_timeout_s=self._client_window_s,
             window=self._pipeline_window,
+            hdr_codec=self._hdr_codec,
+            adaptive_window=self._adaptive_window,
         )
         # a worker's pull and in-flight push threads share this handle;
         # concurrent failures must rebuild the connection once — the
@@ -533,6 +819,8 @@ class ServerHandle:
                         reconnect_timeout_s=self._client_window_s,
                         cid=cid, start_seq=next_seq,
                         window=self._pipeline_window,
+                        hdr_codec=self._hdr_codec,
+                        adaptive_window=self._adaptive_window,
                     )
                     self._sent_sigs = _LruSigs()
                     self._conn_gen += 1
@@ -885,6 +1173,7 @@ def run_server(
         host=bind_host,
         advertise_host=advertise_host,
         fault_plan=_plan_from_cfg(cfg),
+        server_cfg=cfg.server,
     )
     if ckpt_dir:
         if srv.load_state(ckpt_dir):

@@ -187,6 +187,41 @@ class WireConfig:
     # before blocking (run_worker's PushWindow); 0 derives the bound purely
     # from solver.max_delay, so SSP semantics alone shape the window
     max_inflight_pushes: int = 0
+    # derive the EFFECTIVE in-flight window from the client latency
+    # histograms at runtime (shrink on p99 blowup, grow back while healthy
+    # and saturated); ``window`` stays the hard ceiling. Off by default:
+    # a fixed window is deterministic and the adaptation is a tail-latency
+    # guard, not a throughput feature.
+    adaptive_window: bool = False
+    # RPC header codec: "bin" (versioned fixed-layout binary header,
+    # negotiated per connection — a peer that never confirms binary
+    # support keeps receiving JSON) or "json" (wire format of PRs 0-3,
+    # always understood)
+    hdr_codec: str = "bin"
+
+
+@dataclass
+class ServerConfig:
+    """Shard-server batched apply engine (parallel/multislice.py): a
+    dedicated apply thread drains a bounded queue of decoded pushes and
+    coalesces everything concurrently arrived into ONE segment-summed
+    updater apply, while pulls serve from an RCU-published snapshot."""
+
+    # bound of the decoded-push apply queue; 0 disables the engine
+    # entirely (pushes apply inline under the write lock — the serial
+    # pre-engine discipline, kept as the bench baseline)
+    apply_queue: int = 256
+    # max pushes coalesced into one updater apply
+    max_batch: int = 64
+    # reply-coalescing lane bounds, in withheld frames per connection:
+    # control replies (the hi lane) flush at lane_hi, bulk pull/push
+    # replies (the lo lane) at lane_lo
+    lane_hi: int = 4
+    lane_lo: int = 16
+    # byte bound on withheld coalesced replies per connection: pull
+    # replies pin their row arrays while withheld, so the lo lane also
+    # flushes once this many MiB accumulate
+    withheld_max_mb: int = 8
 
 
 @dataclass
@@ -259,6 +294,7 @@ class PSConfig:
     wd: WDConfig = field(default_factory=WDConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     wire: WireConfig = field(default_factory=WireConfig)
+    server: ServerConfig = field(default_factory=ServerConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     model_output: str = ""
@@ -302,6 +338,7 @@ _NESTED = {
     "wd": WDConfig,
     "parallel": ParallelConfig,
     "wire": WireConfig,
+    "server": ServerConfig,
     "fault": FaultConfig,
     "trace": TraceConfig,
 }

@@ -36,6 +36,14 @@ class CounterSet:
         with self._lock:
             self._d[name] = self._d.get(name, 0) + n
 
+    def inc_many(self, items: dict[str, int]) -> None:
+        """Several counters under ONE lock acquisition (hot-path callers
+        like the header codec bump two per frame)."""
+        with self._lock:
+            d = self._d
+            for name, n in items.items():
+                d[name] = d.get(name, 0) + n
+
     def observe_max(self, name: str, v: int) -> None:
         """High-watermark counter (e.g. ``rpc_inflight_peak``: the deepest
         pipelined request window any connection actually reached)."""
@@ -169,6 +177,18 @@ class HistogramSet:
 
 #: process-global per-command RPC latency histograms
 latency_histograms = HistogramSet()
+
+
+def observe_scalar(name: str, value: float) -> None:
+    """Dimensionless histogram observation (apply-batch sizes, queue
+    depths) through the same log2-bucketed machinery as the latency
+    histograms: the value is recorded as if it were that many
+    microseconds, so ``hist_percentile(snap, p) * 1e6`` recovers the
+    value percentile. Sharing ``latency_histograms`` means these ride
+    the heartbeat/telemetry plane (and ``cli stats``) with zero extra
+    plumbing; the ``.n`` suffix convention (``server.apply_batch.n``)
+    marks a series as a count, not a latency."""
+    latency_histograms.observe(name, value / 1e6)
 
 
 class Timer:

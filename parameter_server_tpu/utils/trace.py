@@ -241,6 +241,24 @@ class Tracer:
             "args": args,
         })
 
+    def counter(self, name: str, value: float, cat: str = "") -> None:
+        """Perfetto counter-track sample (Chrome ``"C"`` event): numeric
+        series rendered as a stepped counter track next to the spans —
+        the histogram-export-as-counter-track form the PR-2 ROADMAP item
+        asked for. Used for queue depth and apply-batch size; free when
+        tracing is disabled (same contract as ``span``)."""
+        if self._dir is None:
+            return
+        self._record({
+            "name": name,
+            "cat": cat or "default",
+            "ph": "C",
+            "ts": _now_us(),
+            "pid": os.getpid(),
+            "tid": threading.get_native_id(),
+            "args": {"value": float(value)},
+        })
+
     def flow_start(
         self, name: str, cat: str = "", flow_id: str | None = None,
         **args: Any,
@@ -400,6 +418,10 @@ def span(name: str, cat: str = "", **args: Any):
 
 def instant(name: str, cat: str = "", **args: Any) -> None:
     tracer.instant(name, cat, **args)
+
+
+def counter(name: str, value: float, cat: str = "") -> None:
+    tracer.counter(name, value, cat)
 
 
 def flow_start(

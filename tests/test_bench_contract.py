@@ -41,6 +41,14 @@ def _full(sub_overrides=None, **top):
                                         "pipelined_mb_s": 12.0,
                                         "speedup": 3.4}},
                      "wire_bytes_saved": 41000000},
+        "server_apply": {"push_rps_serial_w8": 86.2,
+                         "push_rps_batched_w8": 284.0,
+                         "batched_speedup_w8": 3.61,
+                         "push_coalesced": 2346,
+                         "push_rps_4k_json": 2629.1,
+                         "push_rps_4k_bin": 3621.1,
+                         "hdr_speedup_4k": 1.38,
+                         "hdr_bytes_saved": 97410},
     }
     sub.update(sub_overrides or {})
     return {
@@ -66,7 +74,9 @@ class TestCompactContract:
             assert k in c, k
         assert set(c["sub"]) >= {"e2e", "ladder", "hbm", "scale", "w2v",
                                  "mf", "darlin", "spmd", "wd", "ingest",
-                                 "rpc"}
+                                 "rpc", "srv"}
+        assert c["sub"]["srv"]["batched_speedup_w8"] == 3.61
+        assert c["sub"]["srv"]["hdr_speedup_4k"] == 1.38
 
     def test_telemetry_block_reaches_the_line(self):
         c = bench._compact_contract(_full(), "f.json")

@@ -1,0 +1,215 @@
+"""Binary header codec + per-connection negotiation (ISSUE 4, fast tier-1).
+
+The wire's header bytes now come in two self-describing codecs: JSON
+(every version) and the versioned fixed-layout binary codec, switched on
+per connection only after the peer proves it decodes binary. These tests
+pin the encode/decode round trip, the JSON fallback for fields the fixed
+layout can't carry, the negotiation handshake, and exactly-once recovery
+riding the binary codec.
+"""
+
+from __future__ import annotations
+
+import socket
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.parallel.chaos import FaultPlan
+from parameter_server_tpu.parallel.control import (
+    _BMAGIC,
+    _decode_bin_header,
+    _encode_bin_header,
+    RpcClient,
+    RpcServer,
+    build_frame,
+    recv_frame_ex,
+    send_frame,
+)
+from parameter_server_tpu.utils.metrics import wire_counters
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    wire_counters.reset()
+    yield
+    wire_counters.reset()
+
+
+def _roundtrip(h, metas=()):
+    b = _encode_bin_header(dict(h), list(metas))
+    assert b is not None
+    assert b[0] == _BMAGIC
+    out = _decode_bin_header(memoryview(b))
+    assert out.pop("arrays") == [list(m) for m in metas]
+    return out
+
+
+class TestBinHeaderCodec:
+    def test_push_request_roundtrip(self):
+        h = {
+            "cmd": "push", "_cid": "abcdef0123456789", "_seq": "k42",
+            "worker": 3, "sig": "00112233", "codec": 0, "zip": True,
+        }
+        metas = [["keys", "<u4", [1024], 0], ["g", "<f4", [1024, 2], 512]]
+        out = _roundtrip(h, metas)
+        assert out == h
+
+    def test_int_seq_and_reply_flags(self):
+        assert _roundtrip({"cmd": "pull", "_seq": 7}) == {
+            "cmd": "pull", "_seq": 7,
+        }
+        assert _roundtrip({"ok": True, "_rseq": 12}) == {
+            "ok": True, "_rseq": 12,
+        }
+        assert _roundtrip(
+            {"ok": True, "need_keys": True, "_transient": True}
+        ) == {"ok": True, "need_keys": True, "_transient": True}
+
+    def test_residual_fields_ride_the_json_tail(self):
+        h = {
+            "cmd": "progress", "worker": 1,
+            "record": {"examples": 10, "auc": 0.9},
+            "_trace": {"tid": "a" * 16, "sid": "b" * 16},
+            "ok": False, "error": "nope",
+        }
+        assert _roundtrip(h) == h
+
+    def test_unknown_cmd_is_carried_as_string(self):
+        assert _roundtrip({"cmd": "totally_new_cmd"}) == {
+            "cmd": "totally_new_cmd"
+        }
+
+    def test_unencodable_fields_fall_back_to_json(self):
+        # a >255-byte cid can't ride the fixed slot; it must still round
+        # trip (through the JSON tail), not corrupt
+        h = {"cmd": "push", "_cid": "x" * 300}
+        assert _roundtrip(h) == h
+        # a non-JSON-serializable value fails BOTH codecs: encode says None
+        assert _encode_bin_header({"cmd": "push", "bad": object()}, []) is None
+
+    def test_negative_and_large_ints(self):
+        h = {"cmd": "pull", "_seq": -5, "worker": -1}
+        assert _roundtrip(h) == h
+        big = {"cmd": "pull", "worker": 1 << 40}  # overflows the i32 slot
+        assert _roundtrip(big) == big  # rides the JSON tail instead
+
+    def test_saved_counter_accounts_the_shrink(self):
+        wire_counters.reset()
+        _encode_bin_header(
+            {"cmd": "push", "_cid": "c" * 16, "_seq": "k1", "worker": 0,
+             "sig": "s" * 16, "codec": 0},
+            [["keys", "<u4", [1024], 0], ["g", "<f4", [1024], 0]],
+        )
+        assert wire_counters.get("hdr_frames_bin") == 1
+        assert wire_counters.get("hdr_bytes_saved") > 30
+
+    def test_frame_roundtrip_over_socket(self, rng):
+        a, b = socket.socketpair()
+        try:
+            x = rng.normal(size=2048).astype(np.float32)
+            keys = np.arange(100, dtype=np.uint32)
+            bufs, _ = build_frame(
+                {"cmd": "push", "_seq": 3, "zip": False},
+                {"keys": keys, "g": x}, bin_hdr=True,
+            )
+            a.sendall(b"".join(bytes(c) for c in bufs))
+            h, out, _, was_bin = recv_frame_ex(b)
+            assert was_bin
+            assert h["cmd"] == "push" and h["_seq"] == 3
+            np.testing.assert_array_equal(out["keys"], keys)
+            np.testing.assert_array_equal(out["g"], x)
+            # zero-copy landing holds for binary headers too
+            assert not out["g"].flags.owndata
+        finally:
+            a.close()
+            b.close()
+
+    def test_json_frames_still_sniff_as_json(self, rng):
+        a, b = socket.socketpair()
+        try:
+            send_frame(a, {"cmd": "x"}, {"g": np.zeros(8, np.float32)})
+            h, out, _, was_bin = recv_frame_ex(b)
+            assert not was_bin and h["cmd"] == "x"
+        finally:
+            a.close()
+            b.close()
+
+
+class TestCodecNegotiation:
+    def _echo(self):
+        return RpcServer(
+            lambda h, a: ({"ok": True, "i": h.get("i")}, {})
+        ).start()
+
+    def test_bin_client_switches_after_first_reply(self):
+        srv = self._echo()
+        cli = RpcClient(srv.address, hdr_codec="bin")
+        try:
+            cli.call("echo", i=0)  # JSON + _bh advert; reply acks
+            assert cli._bin_gen_ok
+            before = wire_counters.get("hdr_frames_bin")
+            for i in range(1, 6):
+                rep, _ = cli.call("echo", i=i)
+                assert rep["i"] == i
+            # request AND reply now ride the binary codec
+            assert wire_counters.get("hdr_frames_bin") >= before + 10
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_json_client_never_switches(self):
+        srv = self._echo()
+        cli = RpcClient(srv.address, hdr_codec="json")
+        try:
+            for i in range(5):
+                rep, _ = cli.call("echo", i=i)
+                assert rep["i"] == i
+            assert not cli._bin_gen_ok
+            assert wire_counters.get("hdr_frames_bin") == 0
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_renegotiates_after_reconnect_and_stays_exactly_once(self):
+        applies = []
+
+        def handler(h, a):
+            applies.append(h.get("i"))
+            return {"ok": True, "i": h.get("i")}, {}
+
+        srv = RpcServer(
+            handler, fault_plan=FaultPlan.parse("disconnect,every=5", seed=3)
+        ).start()
+        cli = RpcClient(srv.address, window=4, reconnect_timeout_s=30.0)
+        try:
+            futs = [cli.call_async("echo", i=i) for i in range(30)]
+            reps = [f.result(timeout=60)[0] for f in futs]
+            assert [r["i"] for r in reps] == list(range(30))
+            assert sorted(applies) == list(range(30))  # exactly once
+            assert wire_counters.get("rpc_reconnects") >= 1
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_bin_frames_interop_with_shard_server_push_pull(self):
+        from parameter_server_tpu.kv.updaters import Sgd
+        from parameter_server_tpu.parallel.multislice import (
+            ServerHandle,
+            ShardServer,
+        )
+        from parameter_server_tpu.utils.config import PSConfig
+        from parameter_server_tpu.utils.keyrange import KeyRange
+
+        srv = ShardServer(Sgd(eta=1.0), KeyRange(0, 256)).start()
+        h = ServerHandle(srv.address, 0, 0, PSConfig(), range_size=256)
+        try:
+            keys = np.arange(1, 33, dtype=np.int64)
+            h.push(keys, np.ones(32, np.float32))  # negotiation roundtrip
+            assert h.client._bin_gen_ok
+            h.push(keys, np.ones(32, np.float32))  # binary push
+            np.testing.assert_allclose(h.pull(keys), -2.0, rtol=1e-6)
+            assert wire_counters.get("hdr_frames_bin") > 0
+        finally:
+            h.shutdown()
+            h.close()

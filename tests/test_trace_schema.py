@@ -18,7 +18,7 @@ import pytest
 
 from parameter_server_tpu.utils import trace
 
-_VALID_PH = {"X", "i", "M", "s", "f"}
+_VALID_PH = {"X", "i", "M", "s", "f", "C"}
 
 
 def _validate_chrome_trace(path: Path) -> list[dict]:
@@ -44,6 +44,8 @@ def _validate_chrome_trace(path: Path) -> list[dict]:
             assert isinstance(ev["id"], str) and ev["id"]
         if ev["ph"] == "f":
             assert ev["bp"] == "e"  # enclosing-slice binding
+        if ev["ph"] == "C":  # counter-track samples carry a numeric value
+            assert isinstance(ev["args"]["value"], (int, float))
     return events
 
 
@@ -281,6 +283,26 @@ class TestTracerEnabled:
             trace.instant("rpc.retry", attempt=1)
         inst = [e for e in armed.events() if e["ph"] == "i"]
         assert inst and inst[0]["args"]["trace_id"] == c.trace_id
+
+    def test_counter_events_export_as_perfetto_counter_track(
+        self, armed
+    ):
+        """The PR-2 ROADMAP leftover: numeric series (queue depth, batch
+        size) export as Chrome ``"C"`` counter events so Perfetto draws
+        them as stepped counter tracks next to the spans."""
+        for v in (1, 4, 2):
+            trace.counter("server.apply_queue_depth", v)
+        path = Path(armed.flush())
+        evs = _validate_chrome_trace(path)  # validator checks C shape
+        cs = [e for e in evs if e["ph"] == "C"]
+        assert [e["args"]["value"] for e in cs] == [1.0, 4.0, 2.0]
+        assert all(e["name"] == "server.apply_queue_depth" for e in cs)
+
+    def test_counter_disabled_is_free(self):
+        # no buffer append, no error, when tracing is off
+        trace.configure(None)
+        trace.counter("x", 1)
+        assert trace.tracer.events() == []
 
     def test_step_context_carries_onto_pool_threads(self, armed):
         # thread locals don't cross ThreadPoolExecutor: a captured wire
