@@ -1,0 +1,104 @@
+"""pslint — project-native static analysis for parameter_server_tpu.
+
+``python -m parameter_server_tpu.analysis`` (or ``cli lint``) walks the
+package and fails on violations of the concurrency and contract
+invariants PRs 1-4 introduced:
+
+    lock-order           static lock-acquisition graph must be acyclic
+    blocking-under-lock  no socket/send/recv, sleep, Future.result,
+                         RPC call, or jit/device sync while holding a lock
+    settle-exactly-once  every DeferredReply is returned and settled on
+                         all exit paths, exception edges included
+    counter-contract     every bumped counter renders in cli stats
+    config-contract      every cfg.<section>.<key> read has a default
+    trace-hygiene        spans only via `with trace.span(...)` / @traced
+    pragma-hygiene       every suppression carries a justification
+
+Suppressions: ``# psl: ignore[<checker>]: <why>`` at the flagged line;
+tree policy in pyproject.toml ``[tool.pslint]``. The runtime complement
+(analysis/witness.py, armed with PS_LOCK_WITNESS=1) enforces the
+lock-order discipline on the orders a live process ACTUALLY takes.
+
+Adding a checker: one module exporting ``check_<name>(index)``, one line
+in ``CHECKERS`` below, one positive+negative test in tests/test_pslint.py.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from parameter_server_tpu.analysis.blocking import check_blocking_under_lock
+from parameter_server_tpu.analysis.contracts import (
+    check_config_contract,
+    check_counter_contract,
+    config_key_usage,
+    counter_inventory,
+)
+from parameter_server_tpu.analysis.core import (
+    PACKAGE_ROOT,
+    Checker,
+    Finding,
+    PackageIndex,
+    PslintConfig,
+    check_pragma_hygiene,
+    load_package,
+    run_checkers,
+)
+from parameter_server_tpu.analysis.lockgraph import (
+    build_lock_graph,
+    check_lock_order,
+)
+from parameter_server_tpu.analysis.settle import check_settle_exactly_once
+from parameter_server_tpu.analysis.tracehygiene import check_trace_hygiene
+
+__all__ = [
+    "CHECKERS",
+    "Checker",
+    "Finding",
+    "PackageIndex",
+    "PslintConfig",
+    "analyze_package",
+    "analyze_sources",
+    "build_lock_graph",
+    "config_key_usage",
+    "counter_inventory",
+    "load_package",
+]
+
+#: name -> checker; the registry every later PR extends
+CHECKERS: dict[str, Checker] = {
+    "lock-order": check_lock_order,
+    "blocking-under-lock": check_blocking_under_lock,
+    "settle-exactly-once": check_settle_exactly_once,
+    "counter-contract": check_counter_contract,
+    "config-contract": check_config_contract,
+    "trace-hygiene": check_trace_hygiene,
+    "pragma-hygiene": check_pragma_hygiene,
+}
+
+
+def _default_config(root: Path) -> PslintConfig:
+    # [tool.pslint] lives in the repo's pyproject.toml, one level above
+    # the package dir
+    return PslintConfig.load(root.parent / "pyproject.toml")
+
+
+def analyze_package(
+    root: Path | str = PACKAGE_ROOT,
+    checkers: dict[str, Checker] | None = None,
+    config: PslintConfig | None = None,
+) -> list[Finding]:
+    """Run the full analyzer over the real package; empty == clean."""
+    root = Path(root)
+    config = config if config is not None else _default_config(root)
+    index = load_package(root, config)
+    return run_checkers(index, checkers or CHECKERS, config)
+
+
+def analyze_sources(
+    sources: dict[str, str],
+    checkers: dict[str, Checker] | None = None,
+) -> list[Finding]:
+    """Run checkers over in-memory sources (tests: crafted snippets)."""
+    index = PackageIndex.from_sources(sources)
+    return run_checkers(index, checkers or CHECKERS, PslintConfig())

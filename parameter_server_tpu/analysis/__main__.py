@@ -1,0 +1,51 @@
+"""``python -m parameter_server_tpu.analysis`` — run pslint, exit 1 on
+findings. The same entry backs ``python -m parameter_server_tpu.cli
+lint`` and the tier-1 clean-package test, so CI, the CLI and the tests
+can never disagree about what clean means."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from parameter_server_tpu.analysis import CHECKERS, PACKAGE_ROOT, analyze_package
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="pslint")
+    p.add_argument(
+        "--root", default=str(PACKAGE_ROOT),
+        help="package directory to analyze (default: the installed "
+        "parameter_server_tpu package)",
+    )
+    p.add_argument(
+        "--checker", action="append", default=None,
+        help="run only this checker (repeatable); default: all "
+        f"({', '.join(CHECKERS)})",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = p.parse_args(argv)
+    checkers = CHECKERS
+    if args.checker:
+        unknown = sorted(set(args.checker) - set(CHECKERS))
+        if unknown:
+            p.error(f"unknown checker(s) {unknown}; known: {sorted(CHECKERS)}")
+        checkers = {n: CHECKERS[n] for n in args.checker}
+    findings = analyze_package(args.root, checkers=checkers)
+    if args.json:
+        print(json.dumps([f.__dict__ for f in findings]))
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"pslint: {len(findings)} finding(s), "
+            f"{len(checkers)} checker(s) over {args.root}"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

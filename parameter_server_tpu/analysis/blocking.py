@@ -1,0 +1,153 @@
+"""Checker ``blocking-under-lock``: no blocking call while holding a lock.
+
+A lock in this codebase protects nanosecond-scale state transitions
+(counter bumps, dict swaps, window bookkeeping). Anything that can park
+the holding thread — socket sends/receives, ``time.sleep``,
+``Future.result``, an RPC ``call``/``call_async``, a jit dispatch or
+device sync (``asarray``/``device_get``/``block_until_ready``), waiting
+on a foreign Event/Condition — stalls every other thread contending for
+that lock for the call's full duration, which is exactly how the reader
+thread ends up unable to complete the reply the blocked send is waiting
+for. The checker walks every function with the held-lock stack and flags
+blocking primitives (directly, or through a call whose transitive
+summary may block).
+
+``cv.wait()`` / ``cv.wait_for()`` on the condition being held is NOT
+flagged: a condition wait releases its lock — that's the one sanctioned
+way to block "under" a lock.
+
+Deliberate exceptions (a lock whose entire purpose is serializing a
+blocking operation, like the client's socket-write lock) carry a
+``# psl: ignore[blocking-under-lock]: <why>`` pragma at the call site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from parameter_server_tpu.analysis.callgraph import CallGraph, OwnerKey
+from parameter_server_tpu.analysis.core import (
+    Finding,
+    HeldLockWalker,
+    PackageIndex,
+    iter_functions,
+    unparse,
+)
+
+#: attribute / function names that park the calling thread
+BLOCKING_ATTRS = frozenset({
+    # sockets
+    "sendall", "sendmsg", "send", "sendto",
+    "recv", "recv_into", "recvfrom", "accept", "connect",
+    # time
+    "sleep",
+    # futures / RPC round trips
+    "result", "call", "call_async",
+    # device sync / jit dispatch boundaries
+    "asarray", "device_get", "block_until_ready",
+})
+
+#: blocking only when the receiver is NOT the lock being held (a
+#: condition wait releases its own lock; an Event.wait under a DIFFERENT
+#: lock holds that lock for the whole park)
+WAIT_ATTRS = frozenset({"wait", "wait_for"})
+
+
+def _blocks_directly(fndef: ast.AST) -> bool:
+    for sub in ast.walk(fndef):
+        if isinstance(sub, ast.Call) and _blocking_name(sub) is not None:
+            return True
+    return False
+
+
+def _blocking_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in BLOCKING_ATTRS:
+        return fn.attr
+    return None
+
+
+def may_block_summaries(graph: CallGraph) -> dict[OwnerKey, bool]:
+    return graph.summarize(
+        direct=lambda owner, rp, cn, fd: _blocks_directly(fd),
+        merge=lambda a, b: a or b,
+        bottom=lambda: False,
+    )
+
+
+class _BlockWalker(HeldLockWalker):
+    def __init__(
+        self,
+        graph: CallGraph,
+        relpath: str,
+        cls_name: str | None,
+        summaries: dict[OwnerKey, bool],
+        out: list[Finding],
+    ):
+        super().__init__(self._lock_key)
+        self._graph = graph
+        self._relpath = relpath
+        self._cls = cls_name
+        self._summaries = summaries
+        self._out = out
+        self._seen: set[int] = set()  # one finding per line
+
+    def _lock_key(self, expr: ast.AST) -> str | None:
+        g = self._graph
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self._cls is not None
+        ):
+            return g.lock_attr_key(self._cls, expr.attr)
+        if isinstance(expr, ast.Name):
+            return g.module_locks.get(expr.id)
+        return None
+
+    def on_call(self, node: ast.Call, held: list) -> None:
+        if not held:
+            return
+        what: str | None = None
+        name = _blocking_name(node)
+        if name is not None:
+            what = f"{unparse(node.func)}(...)"
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in WAIT_ATTRS
+        ):
+            recv = unparse(node.func.value)
+            if all(recv != expr for _, expr, _ in held):
+                what = (
+                    f"{unparse(node.func)}(...) "
+                    "(waits on an object that is not the held lock)"
+                )
+        else:
+            for callee in self._graph.callees(self._relpath, self._cls, node):
+                if self._summaries.get(callee):
+                    what = (
+                        f"{unparse(node.func)}(...) "
+                        f"(transitively blocking via {callee[1]}.{callee[2]})"
+                    )
+                    break
+        if what is None or node.lineno in self._seen:
+            return
+        self._seen.add(node.lineno)
+        locks = ", ".join(sorted({k for k, _, _ in held}))
+        self._out.append(Finding(
+            "blocking-under-lock", self._relpath, node.lineno,
+            f"{what} while holding {locks}: the holder parks every "
+            "thread contending for the lock for the call's duration",
+        ))
+
+
+def check_blocking_under_lock(index: PackageIndex) -> list[Finding]:
+    graph = CallGraph(index)
+    summaries = may_block_summaries(graph)
+    out: list[Finding] = []
+    for f in index.files:
+        for cls_name, fndef in iter_functions(f.tree):
+            _BlockWalker(graph, f.relpath, cls_name, summaries, out).walk_function(
+                fndef
+            )
+    return out

@@ -1,0 +1,350 @@
+"""pslint core: package loading, findings, suppressions, the runner.
+
+The analyzer is project-native: checkers encode THIS codebase's
+concurrency and contract invariants (lock ordering, no blocking calls
+under a lock, DeferredReply settlement, counter/config inventories,
+trace span hygiene) instead of generic style rules. Each checker is a
+function ``(PackageIndex) -> list[Finding]`` registered in
+``analysis/__init__.py``; adding a checker to a later PR is one module
+plus one registry line.
+
+Suppressions are explicit and audited:
+
+- file:line pragma — ``# psl: ignore[<checker>]: <justification>`` on
+  the flagged line (or a standalone comment on the line directly
+  above). The justification string is REQUIRED; a bare pragma is itself
+  a finding (``pragma-hygiene``), so every silenced warning carries its
+  reason in the diff forever.
+- ``[tool.pslint]`` in pyproject.toml — ``exclude`` path globs and
+  ``disable`` checker names for whole-tree policy.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+#: package root of the code under analysis (the installed package dir)
+PACKAGE_ROOT = Path(__file__).resolve().parent.parent
+
+_PRAGMA_RE = re.compile(
+    r"#\s*psl:\s*ignore\[([a-z0-9_*,\s-]+)\]\s*(?::\s*(.*\S))?\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer violation, pointing at a repo file:line."""
+
+    checker: str
+    path: str  # relative to the analyzed root
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+@dataclass
+class Pragma:
+    line: int  # the line the pragma SUPPRESSES (not where it sits)
+    checkers: frozenset[str]  # {"*"} suppresses every checker
+    justification: str
+    pragma_line: int  # where the comment physically lives
+
+
+@dataclass
+class SourceFile:
+    """One parsed module: AST + raw text + its suppression pragmas."""
+
+    path: Path
+    relpath: str
+    text: str
+    tree: ast.Module
+    pragmas: dict[int, Pragma] = field(default_factory=dict)
+
+    def line(self, lineno: int) -> str:
+        lines = self.text.splitlines()
+        return lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+
+
+@dataclass
+class PslintConfig:
+    """``[tool.pslint]`` policy (pyproject.toml)."""
+
+    exclude: list[str] = field(default_factory=list)  # relpath globs
+    disable: list[str] = field(default_factory=list)  # checker names
+
+    @classmethod
+    def load(cls, pyproject: Path | None) -> "PslintConfig":
+        if pyproject is None or not pyproject.exists():
+            return cls()
+        from parameter_server_tpu.utils.config import toml_module
+
+        data = toml_module().loads(pyproject.read_text())
+        sec = data.get("tool", {}).get("pslint", {})
+        return cls(
+            exclude=list(sec.get("exclude", [])),
+            disable=list(sec.get("disable", [])),
+        )
+
+
+def _parse_pragmas(text: str) -> dict[int, Pragma]:
+    """Map suppressed-line -> Pragma. A pragma trailing code suppresses
+    its own line; a pragma on a comment-only line suppresses the NEXT
+    line (for statements too long to share a line with their reason)."""
+    out: dict[int, Pragma] = {}
+    for i, raw in enumerate(text.splitlines(), start=1):
+        m = _PRAGMA_RE.search(raw)
+        if m is None:
+            continue
+        checkers = frozenset(
+            c.strip() for c in m.group(1).split(",") if c.strip()
+        )
+        target = i + 1 if raw.lstrip().startswith("#") else i
+        out[target] = Pragma(
+            line=target,
+            checkers=checkers,
+            justification=(m.group(2) or "").strip(),
+            pragma_line=i,
+        )
+    return out
+
+
+class PackageIndex:
+    """Parsed view of every analyzed module, shared by all checkers."""
+
+    def __init__(self, files: list[SourceFile], root: Path):
+        self.files = files
+        self.root = root
+        self._by_rel = {f.relpath: f for f in files}
+
+    def get(self, relpath: str) -> SourceFile | None:
+        return self._by_rel.get(relpath)
+
+    @classmethod
+    def from_sources(
+        cls, sources: dict[str, str], root: Path | None = None
+    ) -> "PackageIndex":
+        """In-memory index (tests: crafted positive/negative snippets)."""
+        files = [
+            SourceFile(
+                path=Path(rel),
+                relpath=rel,
+                text=src,
+                tree=ast.parse(src, filename=rel),
+                pragmas=_parse_pragmas(src),
+            )
+            for rel, src in sources.items()
+        ]
+        return cls(files, root or Path("."))
+
+
+def load_package(
+    root: Path | str = PACKAGE_ROOT, config: PslintConfig | None = None
+) -> PackageIndex:
+    root = Path(root)
+    config = config or PslintConfig()
+    files: list[SourceFile] = []
+    for p in sorted(root.rglob("*.py")):
+        rel = p.relative_to(root).as_posix()
+        if any(fnmatch.fnmatch(rel, g) for g in config.exclude):
+            continue
+        text = p.read_text()
+        files.append(
+            SourceFile(
+                path=p,
+                relpath=rel,
+                text=text,
+                tree=ast.parse(text, filename=str(p)),
+                pragmas=_parse_pragmas(text),
+            )
+        )
+    return PackageIndex(files, root)
+
+
+Checker = Callable[[PackageIndex], list[Finding]]
+
+
+def check_pragma_hygiene(index: PackageIndex) -> list[Finding]:
+    """A suppression without a justification is a violation: the pragma
+    grammar REQUIRES ``# psl: ignore[checker]: why`` so silenced
+    findings stay auditable in the diff."""
+    out: list[Finding] = []
+    for f in index.files:
+        for pr in f.pragmas.values():
+            if not pr.justification:
+                out.append(Finding(
+                    "pragma-hygiene", f.relpath, pr.pragma_line,
+                    "pslint pragma without a justification string "
+                    "(required form: # psl: ignore[<checker>]: <why>)",
+                ))
+            if not pr.checkers:
+                out.append(Finding(
+                    "pragma-hygiene", f.relpath, pr.pragma_line,
+                    "pslint pragma names no checker",
+                ))
+    return out
+
+
+def apply_suppressions(
+    index: PackageIndex, findings: list[Finding]
+) -> list[Finding]:
+    out = []
+    for fi in findings:
+        sf = index.get(fi.path)
+        if sf is not None and fi.checker != "pragma-hygiene":
+            pr = sf.pragmas.get(fi.line)
+            if pr is not None and pr.justification and (
+                "*" in pr.checkers or fi.checker in pr.checkers
+            ):
+                continue
+        out.append(fi)
+    return out
+
+
+def run_checkers(
+    index: PackageIndex,
+    checkers: dict[str, Checker],
+    config: PslintConfig | None = None,
+) -> list[Finding]:
+    """Run every enabled checker and apply pragma suppressions; the
+    returned list is what gates CI (empty == clean)."""
+    config = config or PslintConfig()
+    findings: list[Finding] = []
+    for name, fn in checkers.items():
+        if name in config.disable:
+            continue
+        findings.extend(fn(index))
+    findings = apply_suppressions(index, findings)
+    findings.sort(key=lambda fi: (fi.path, fi.line, fi.checker))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# shared AST utilities used by the concurrency checkers
+# ---------------------------------------------------------------------------
+
+
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - exotic nodes
+        return "<expr>"
+
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def lock_ctor_name(call: ast.AST) -> str | None:
+    """``threading.Lock()`` / ``Lock()`` -> "Lock" (None otherwise)."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_CTORS:
+        if isinstance(fn.value, ast.Name) and fn.value.id == "threading":
+            return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_CTORS:
+        return fn.id
+    return None
+
+
+def iter_functions(
+    tree: ast.Module,
+) -> list[tuple[str | None, ast.FunctionDef | ast.AsyncFunctionDef]]:
+    """Every (owning class name or None, function) in a module. Nested
+    functions are yielded under their enclosing class (closures over
+    ``self`` — the server loop's helpers — analyze with class context)."""
+    out: list[tuple[str | None, Any]] = []
+
+    def walk(node: ast.AST, cls: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((cls, child))
+                walk(child, cls)
+            else:
+                walk(child, cls)
+
+    walk(tree, None)
+    return out
+
+
+class HeldLockWalker:
+    """Statement-order walk of one function body tracking which lock
+    expressions are held (``with`` statements over lock-typed
+    expressions). Subclasses get ``on_call(node, held)`` for every Call
+    observed with the current held stack (list of (lock_key, expr_str,
+    with_line))."""
+
+    def __init__(self, is_lock_expr: Callable[[ast.AST], str | None]):
+        # is_lock_expr: context expr -> lock key (None: not a lock)
+        self._is_lock = is_lock_expr
+
+    def on_call(
+        self, node: ast.Call, held: list[tuple[str, str, int]]
+    ) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def on_acquire(
+        self, key: str, held: list[tuple[str, str, int]], line: int
+    ) -> None:
+        """Called when a ``with <lock>`` is entered, BEFORE the lock is
+        pushed onto ``held`` (the lock-order checker's edge source)."""
+
+    def walk_function(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        self._walk_body(fn.body, [])
+
+    def _walk_body(self, body: list, held: list[tuple[str, str, int]]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, held)
+
+    def _walk_stmt(self, stmt: ast.stmt, held: list) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs run later, not while these locks are held
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in stmt.items:
+                self._visit_expr(item.context_expr, held)
+                key = self._is_lock(item.context_expr)
+                if key is not None:
+                    self.on_acquire(key, held, stmt.lineno)
+                    held.append(
+                        (key, unparse(item.context_expr), stmt.lineno)
+                    )
+                    pushed += 1
+            self._walk_body(stmt.body, held)
+            for _ in range(pushed):
+                held.pop()
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, held)
+            for h in stmt.handlers:
+                self._walk_body(h.body, held)
+            self._walk_body(stmt.orelse, held)
+            self._walk_body(stmt.finalbody, held)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._visit_expr(stmt.test, held)
+            self._walk_body(stmt.body, held)
+            self._walk_body(stmt.orelse, held)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._visit_expr(stmt.iter, held)
+            self._walk_body(stmt.body, held)
+            self._walk_body(stmt.orelse, held)
+            return
+        # expression-bearing simple statements
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                self.on_call(sub, held)
+
+    def _visit_expr(self, expr: ast.AST, held: list) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                self.on_call(sub, held)

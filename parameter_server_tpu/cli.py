@@ -148,6 +148,19 @@ def _build_parser() -> argparse.ArgumentParser:
     st.add_argument(
         "--scheduler", required=True, help="coordinator host:port"
     )
+
+    li = sub.add_parser(
+        "lint",
+        help="run pslint — the project-native static analyzer "
+        "(python -m parameter_server_tpu.analysis): lock-order, "
+        "blocking-under-lock, settle-exactly-once, counter/config "
+        "contracts, trace hygiene; exits nonzero on findings",
+    )
+    li.add_argument(
+        "--checker", action="append", default=None,
+        help="run only this checker (repeatable)",
+    )
+    li.add_argument("--json", action="store_true")
     return p
 
 
@@ -552,6 +565,16 @@ def run_stats(args: argparse.Namespace) -> dict:
 
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
+    if args.cmd == "lint":
+        # no config file: lint analyzes the installed package source
+        from parameter_server_tpu.analysis.__main__ import main as lint_main
+
+        lint_argv: list[str] = []
+        for c in args.checker or ():
+            lint_argv += ["--checker", c]
+        if args.json:
+            lint_argv.append("--json")
+        return lint_main(lint_argv)
     if args.cmd == "stats":
         # no config file: stats only needs a live coordinator address
         print(json.dumps(run_stats(args), default=float))

@@ -11,6 +11,18 @@ Push/Pull are XLA collectives on ICI instead of ZeroMQ messages; the SSP
 bounded-delay clock is a host-side gate on step dispatch.
 """
 
+# debug lock-order witness (analysis/witness.py): chaos-style opt-in —
+# PS_LOCK_WITNESS=1 wraps threading.Lock/RLock/Condition construction in
+# this package's modules and raises on any inversion of the statically
+# derived acquisition order. Armed BEFORE the submodule imports below so
+# even import-time singletons in this subpackage are instrumented.
+import os as _os  # noqa: E402
+
+if _os.environ.get("PS_LOCK_WITNESS", "") not in ("", "0"):
+    from parameter_server_tpu.analysis import witness as _witness
+
+    _witness.maybe_install_from_env()
+
 from parameter_server_tpu.parallel import runtime  # noqa: F401
 from parameter_server_tpu.parallel.mesh import make_mesh  # noqa: F401
 from parameter_server_tpu.parallel.runtime import Runtime  # noqa: F401

@@ -783,8 +783,12 @@ class RpcServer:
         def settle_deferred() -> None:
             """Resolve every pending deferred reply into the lo lane (in
             arrival order). Called before any point where this thread
-            would block on the socket or sever the connection."""
-            for seq_d, d, cmd_d, t_d, bin_d, adv_d in deferred:
+            would block on the socket or sever the connection. Entries
+            pop as they settle, so on the error edge below the finally
+            drain sees exactly the entries whose replies were never
+            queued — none stranded, none double-counted."""
+            while deferred:
+                seq_d, d, cmd_d, t_d, bin_d, adv_d = deferred[0]
                 try:
                     rep_d, arrays_d = d.future.result()
                 except ConnectionError:
@@ -793,12 +797,14 @@ class RpcServer:
                     # remote error and the client would never resend —
                     # sever the connection instead, so the transport heal
                     # retries against the relaunched server (the durable
-                    # ledger dedups any half-applied overlap)
-                    deferred.clear()
+                    # ledger dedups any half-applied overlap). The
+                    # still-parked remainder (this entry included) is
+                    # consumed by the conn teardown's finally drain.
                     flush_replies()
                     raise
                 except Exception as e:  # noqa: BLE001 — surfaced remotely
                     rep_d, arrays_d = {"ok": False, "error": repr(e)}, {}
+                deferred.pop(0)
                 latency_histograms.observe(
                     f"server.{cmd_d}", time.perf_counter() - t_d
                 )
@@ -806,7 +812,6 @@ class RpcServer:
                     decorated(rep_d, seq_d, adv_d), arrays_d,
                     hi=False, bin_hdr=bin_d,
                 )
-            deferred.clear()
         with self._counter_lock:
             self._conns.add(conn)
         # register-then-check pairs with stop()'s set-then-sever: a conn
@@ -941,6 +946,24 @@ class RpcServer:
         except (ValueError, KeyError, IndexError, struct.error, zlib.error):
             return  # undecodable frame: framing lost, sever the conn
         finally:
+            # settle-exactly-once, exception edges included (pslint
+            # settle-exactly-once true positive): a conn torn down by a
+            # socket error or an undecodable frame may still hold parked
+            # deferred replies. Their SENDS are lost with the connection
+            # (the client's heal resends; the durable ledger dedups) but
+            # every future is still consumed here, so a parked apply's
+            # error can't vanish with the conn thread and the parked
+            # result arrays drop their last reference promptly.
+            for _, d, *_rest in deferred:
+                wire_counters.inc("rpc_deferred_orphaned")
+                try:
+                    # the apply engine resolves every queued push, even
+                    # at shutdown (_fail_stopping) — the timeout is a
+                    # backstop, not an expected path
+                    d.future.exception(timeout=30)
+                except Exception:  # noqa: BLE001 — reply already lost
+                    pass
+            deferred.clear()
             try:
                 conn.close()
             except OSError:
@@ -1496,6 +1519,7 @@ class RpcClient:
                 bufs, n = build_frame(p.header, p.arrays, bin_hdr=use_bin)
                 try:
                     with self._send_lock:
+                        # psl: ignore[blocking-under-lock]: _send_lock exists solely to serialize writes to one socket between this inline fast path and the writer thread; it guards no other state and the reader thread never takes it
                         _send_gather(sock, bufs)
                     with self._cv:
                         self.bytes_out += n
@@ -1574,6 +1598,7 @@ class RpcClient:
                 wire_counters.inc("wire_frames_coalesced", len(batch) - 1)
             try:
                 with self._send_lock:
+                    # psl: ignore[blocking-under-lock]: _send_lock exists solely to serialize socket writes between the writer thread and the inline fast path; a send parked on backpressure is the socket's own flow control, not contended state
                     _send_gather(sock, bufs)
             except (ConnectionError, OSError):
                 self._conn_died(sock, gen)  # heal resends the claimed batch

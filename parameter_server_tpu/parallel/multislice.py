@@ -399,6 +399,7 @@ class ShardServer:
                 # batches to a handful of compiled shapes (pad rows are
                 # PAD_KEY 0 + zero grad, which every updater maps to a
                 # zero delta per the store invariant)
+                # psl: ignore[blocking-under-lock]: the apply lock must span the ledger check, the coalesce+jitted apply and the publish — the serial raw-frame path mutates state under this same lock, so an unlocked compute window would lose any raw push that interleaved
                 idx, grad = kv_store.coalesce_pushes(
                     [p.keys for p in todo], [p.grad for p in todo],
                     pad_to_pow2=True,
@@ -414,7 +415,7 @@ class ShardServer:
                     # snapshot readers (pull/dump) until they drop them.
                     new_state = kv_store.push(
                         self.updater, self.state,
-                        self._jnp.asarray(idx), self._jnp.asarray(grad),
+                        self._jnp.asarray(idx), self._jnp.asarray(grad),  # psl: ignore[blocking-under-lock]: same unit as the coalesce above — ledger check, jitted apply and RCU publish are one atomic section vs the serial raw-frame path
                     )
                     for p in todo:
                         if p.cid is not None:
@@ -476,13 +477,20 @@ class ShardServer:
             range=f"{self.range.begin}-{self.range.end}",
         ):
             with self._lock:
-                host = {k: np.asarray(v) for k, v in self.state.items()}
-                # same critical section as the state snapshot: the ledger
-                # in a checkpoint must witness exactly the pushes that
-                # checkpoint contains — never one more, never one fewer
+                # same critical section for the state REFERENCE and the
+                # ledger: the ledger in a checkpoint must witness exactly
+                # the pushes that checkpoint contains — never one more,
+                # never one fewer. Only the reference capture needs the
+                # lock (the published dict is immutable after the RCU
+                # swap); the device->host transfer below runs OUTSIDE it
+                # (pslint blocking-under-lock true positive: the full-
+                # state D2H sync used to stall every push for the
+                # checkpoint's duration).
+                state = self.state
                 ledger = json.dumps(
                     {cid: list(per) for cid, per in self._applied_push.items()}
                 )
+            host = {k: np.asarray(v) for k, v in state.items()}
             with self._ckpt_write_lock:
                 os.makedirs(ckpt_dir, exist_ok=True)
                 path = self._ckpt_path(ckpt_dir)
@@ -519,8 +527,13 @@ class ShardServer:
         if ledger_raw is not None:  # absent in pre-ledger checkpoints
             for cid, seqs in json.loads(ledger_raw.tobytes().decode()).items():
                 applied[cid] = OrderedDict((str(s), None) for s in seqs)
+        # host->device transfer OUTSIDE the lock (pslint
+        # blocking-under-lock): only the two reference swaps need the
+        # critical section — they form the same atomic state+ledger unit
+        # save_state snapshots
+        new_state = {k: self._jnp.asarray(v) for k, v in host.items()}
         with self._lock:
-            self.state = {k: self._jnp.asarray(v) for k, v in host.items()}
+            self.state = new_state
             self._applied_push = applied
         return True
 
@@ -624,6 +637,7 @@ class ShardServer:
             with trace.span("server.updater", cat="ps", keys=len(keys)):
                 with self._lock:
                     rows = {k: v[keys] for k, v in self.state.items()}
+                    # psl: ignore[blocking-under-lock]: the serial path ([server] apply_queue = 0) applies INLINE under the write lock by definition — that serialization is the pre-engine baseline discipline the engine is benchmarked against
                     deltas = self.updater.delta(rows, self._jnp.asarray(g))
                     self.state = {
                         k: self.state[k].at[keys].add(deltas[k])
@@ -720,6 +734,13 @@ class ServerHandle:
         # generation counter lets a late-arriving failing thread see that
         # another thread already replaced the client and just retry
         self._reconnect_lock = threading.Lock()
+        # the recovery-executor singleton gets its OWN lock (pslint
+        # blocking-under-lock true positive): _recovery() used to share
+        # _reconnect_lock, so the client's READER thread — which calls
+        # _recovery() from a completion callback — could park for a full
+        # reconnect window behind a thread sleeping inside _reconnect,
+        # stalling every other in-flight completion on that connection
+        self._pool_lock = threading.Lock()
         self._conn_gen = 0
         self._sent_sigs = _LruSigs()
         self._key_caching = cfg.filter.key_caching
@@ -814,6 +835,7 @@ class ServerHandle:
             while time.monotonic() < deadline:
                 try:
                     addr = self._resolve_addr()
+                    # psl: ignore[blocking-under-lock]: _reconnect_lock IS the serialization of connection rebuilds — concurrent failing threads must park until exactly one rebuild completes; no completion/reader thread takes it (the recovery pool moved to _pool_lock)
                     self.client = RpcClient(
                         addr, retries=1,
                         reconnect_timeout_s=self._client_window_s,
@@ -827,6 +849,7 @@ class ServerHandle:
                     return
                 except (ConnectionError, OSError) as e:
                     last = e
+                    # psl: ignore[blocking-under-lock]: rebuild-retry backoff under the rebuild serialization lock — waiters WANT to park until the one rebuild lands (see the pragma above)
                     time.sleep(0.3)
         raise ConnectionError(
             f"server rank {self.rank} unreachable for "
@@ -945,7 +968,7 @@ class ServerHandle:
             outer.set_exception(e)
 
     def _recovery(self) -> ThreadPoolExecutor:
-        with self._reconnect_lock:
+        with self._pool_lock:  # NOT _reconnect_lock: see __init__
             if self._recovery_pool is None:
                 self._recovery_pool = ThreadPoolExecutor(
                     max_workers=1,

@@ -344,22 +344,26 @@ _NESTED = {
 }
 
 
+def toml_module():
+    """The tomllib import ladder, shared with pslint's ``[tool.pslint]``
+    loader (analysis/core.py): stdlib tomllib (python >= 3.11), the
+    tomli upstream, then — last resort on dep-frozen 3.10 images — pip's
+    vendored copy; prefer a fragile import to losing .toml support."""
+    try:
+        import tomllib  # stdlib, python >= 3.11
+    except ModuleNotFoundError:
+        try:
+            import tomli as tomllib  # the stdlib module's upstream
+        except ModuleNotFoundError:
+            from pip._vendor import tomli as tomllib
+    return tomllib
+
+
 def load_config(path: str | Path) -> PSConfig:
     """Load a PSConfig from a .json or .toml file."""
     p = Path(path)
     if p.suffix == ".toml":
-        try:
-            import tomllib  # stdlib, python >= 3.11
-        except ModuleNotFoundError:
-            try:
-                import tomli as tomllib  # the stdlib module's upstream
-            except ModuleNotFoundError:
-                # last resort on dep-frozen 3.10 images: pip vendors the
-                # same tomli; prefer a fragile import to losing .toml
-                # support entirely
-                from pip._vendor import tomli as tomllib
-
-        d = tomllib.loads(p.read_text())
+        d = toml_module().loads(p.read_text())
     else:
         d = json.loads(p.read_text())
     return _from_dict(PSConfig, d)

@@ -30,6 +30,9 @@ assert jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8, (
     "tests must run on the 8-device virtual CPU mesh; got " + str(jax.devices())
 )
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -37,3 +40,58 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_order_witness():
+    """Arm the runtime lock-order witness (analysis/witness.py) for the
+    whole tier-1 run: every lock the package constructs during tests is
+    order-checked against the statically derived acquisition graph plus
+    whatever orders the run itself witnesses. An inversion raises
+    LockOrderViolation at the acquiring call site — a deterministic
+    stack trace instead of a probabilistic deadlock hang in CI."""
+    from parameter_server_tpu.analysis import witness
+
+    witness.install()
+    yield
+    witness.uninstall()
+
+
+#: thread-name prefixes exempt from the stray-thread check: stdlib /
+#: third-party executor singletons (e.g. jax's compilation pools) that
+#: legitimately outlive a test. Package-owned executors deliberately use
+#: the "ps-" prefix so they can never hide here.
+_THREAD_ALLOWLIST = ("ThreadPoolExecutor-",)
+
+
+@pytest.fixture(autouse=True)
+def _no_stray_threads():
+    """Fail any test that leaves non-daemon threads alive: a leaked
+    thread is an unjoined executor or an unstopped server — it pins its
+    captured state for the rest of the session and can deadlock
+    interpreter shutdown. Daemon threads (the package's serving/reader
+    threads are all daemonized by design) are out of scope."""
+    # compare Thread OBJECTS, not idents: idents are documented as
+    # recyclable after a thread exits, so a leaked thread could inherit
+    # a recycled ident from the before-set and evade the check
+    before = set(threading.enumerate())
+    yield
+    deadline = time.monotonic() + 5.0
+    leaked: list[str] = []
+    for t in threading.enumerate():
+        if (
+            t in before
+            or t.daemon
+            or t is threading.current_thread()
+            or any(t.name.startswith(p) for p in _THREAD_ALLOWLIST)
+        ):
+            continue
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            leaked.append(t.name)
+    if leaked:
+        pytest.fail(
+            f"test leaked live non-daemon thread(s): {leaked} "
+            "(join/stop them, or allowlist a deliberate singleton in "
+            "tests/conftest.py)"
+        )

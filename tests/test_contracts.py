@@ -1,50 +1,52 @@
-"""CI contract tests (ISSUE 4 satellite): every counter bumped in code
-is visible in the cluster dashboard, every ``[server]``/``[wire]`` config
-key read by code exists with a default in ``utils/config.py``, and the
-bench compact line schema carries the ``server_apply`` acceptance cell —
-so a new knob or counter can't silently drop out of the dashboards.
+"""CI contract tests (ISSUE 4 satellite; ISSUE 5 migrated them onto
+pslint's DERIVED inventories): every counter bumped in code is visible
+in the cluster dashboard, every ``[server]``/``[wire]`` config key read
+by code exists with a default in ``utils/config.py``, and the bench
+compact line schema carries the ``server_apply`` acceptance cell.
+
+The counter and config inventories are no longer regex lists maintained
+here — they come from ``parameter_server_tpu.analysis.contracts``
+(the same AST scan the ``counter-contract`` / ``config-contract``
+checkers gate CI with), so the lists can never drift from the code.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import re
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
-PKG = ROOT / "parameter_server_tpu"
 sys.path.insert(0, str(ROOT))
 
 import bench  # noqa: E402
 
-_SRC = {p: p.read_text() for p in PKG.rglob("*.py")}
+from parameter_server_tpu.analysis import (  # noqa: E402
+    config_key_usage,
+    counter_inventory,
+    load_package,
+)
+
+_INDEX = load_package()
 
 
 class TestCounterContract:
     def test_every_literal_counter_reaches_format_cluster_stats(self):
-        """Every counter name bumped via wire_counters.inc/observe_max
-        must appear in the ``cli stats`` dashboard output (the merged
-        counter block prints every merged name — this breaks if someone
-        filters it or renames a counter without the dashboard noticing).
-        Dynamic names (``fault_{action}``) are covered by their own
+        """Every counter name bumped via wire_counters.inc/observe_max/
+        inc_many must appear in the ``cli stats`` dashboard output (the
+        merged counter block prints every merged name — this breaks if
+        someone filters it or renames a counter without the dashboard
+        noticing). The inventory is DERIVED by pslint's AST scan;
+        dynamic names (``fault_{action}``) are covered by their own
         chaos-stats path and are out of scope of the literal scan."""
-        pat = re.compile(
-            r"wire_counters\.(?:inc|observe_max)\(\s*[\"']([a-z0-9_]+)[\"']"
-        )
-        many = re.compile(
-            r"wire_counters\.inc_many\(\{([^}]*)\}", re.DOTALL
-        )
-        names: set[str] = set()
-        for text in _SRC.values():
-            names.update(pat.findall(text))
-            for blob in many.findall(text):
-                names.update(re.findall(r"[\"']([a-z0-9_]+)[\"']\s*:", blob))
+        names = set(counter_inventory(_INDEX))
         # the tentpole counters must be part of the scanned inventory
         assert {
             "push_coalesced", "hdr_bytes_saved", "hdr_frames_bin",
             "wire_withheld_bytes_peak", "wire_window_shrinks",
             "wire_window_grows",
+            # ISSUE 5: orphaned deferred replies consumed on conn death
+            "rpc_deferred_orphaned",
         } <= names
         from parameter_server_tpu.utils.metrics import format_cluster_stats
 
@@ -57,6 +59,15 @@ class TestCounterContract:
         out = format_cluster_stats(rep)
         missing = sorted(n for n in names if n not in out)
         assert not missing, f"counters invisible to cli stats: {missing}"
+
+    def test_inventory_matches_the_ci_checker(self):
+        """The checker that gates CI and the inventory this test uses
+        are one code path — a counter passing here cannot fail there."""
+        from parameter_server_tpu.analysis.contracts import (
+            check_counter_contract,
+        )
+
+        assert check_counter_contract(_INDEX) == []
 
     def test_peak_counters_merge_as_max(self):
         """*_peak gauges (withheld bytes, inflight depth) must merge as a
@@ -74,40 +85,44 @@ class TestCounterContract:
 
 class TestConfigKeyContract:
     @staticmethod
-    def _fields(cls) -> dict[str, object]:
+    def _fields(cls) -> dict[str, bool]:
         out = {}
         for f in dataclasses.fields(cls):
-            has_default = (
+            out[f.name] = (
                 f.default is not dataclasses.MISSING
                 or f.default_factory is not dataclasses.MISSING
             )
-            out[f.name] = has_default
         return out
+
+    def _check_section(self, section: str, cls) -> None:
+        usage = config_key_usage(_INDEX)
+        used = set(usage.get(section, {}))
+        assert used, f"the [{section}] usage scan found nothing"
+        fields = self._fields(cls)
+        missing = sorted(used - set(fields))
+        assert not missing, (
+            f"[{section}] keys used without a default: {missing}"
+        )
+        assert all(fields.values())
 
     def test_every_used_wire_key_has_a_default(self):
         from parameter_server_tpu.utils.config import WireConfig
 
-        used: set[str] = set()
-        for text in _SRC.values():
-            used.update(re.findall(r"cfg\.wire\.(\w+)", text))
-        fields = self._fields(WireConfig)
-        assert used, "the [wire] usage scan found nothing"
-        missing = sorted(used - set(fields))
-        assert not missing, f"[wire] keys used without a default: {missing}"
-        assert all(fields.values())
+        self._check_section("wire", WireConfig)
 
     def test_every_used_server_key_has_a_default(self):
         from parameter_server_tpu.utils.config import ServerConfig
 
-        used: set[str] = set()
-        for text in _SRC.values():
-            used.update(re.findall(r"cfg\.server\.(\w+)", text))
-            used.update(re.findall(r"\bscfg\.(\w+)", text))
-        fields = self._fields(ServerConfig)
-        assert used, "the [server] usage scan found nothing"
-        missing = sorted(used - set(fields))
-        assert not missing, f"[server] keys used without a default: {missing}"
-        assert all(fields.values())
+        self._check_section("server", ServerConfig)
+
+    def test_every_section_passes_the_ci_checker(self):
+        """Beyond [wire]/[server]: the pslint checker covers EVERY
+        config section's reads (data, solver, fault, trace, ...)."""
+        from parameter_server_tpu.analysis.contracts import (
+            check_config_contract,
+        )
+
+        assert check_config_contract(_INDEX) == []
 
     def test_server_section_loads_from_config_file(self, tmp_path):
         from parameter_server_tpu.utils.config import load_config
