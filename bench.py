@@ -84,6 +84,12 @@ Sub-benches ("sub"):
                  vs OFF (the serial per-push lock), plus small-frame
                  (4 KiB) pipelined push rps with binary vs JSON headers
                  against a separate-process ack server.
+  quant_wire   — quantized push/pull wire A/B (ISSUE 6 acceptance): the
+                 linear-method e2e workload trained over the real wire
+                 tier at f32 / int8+error-feedback / int16 with identical
+                 seeds; measured push payload ratio (>= 3x at int8) and
+                 AUC parity (|dAUC| <= 0.002) per arm, plus the
+                 residual-norm peak gauge.
   last_tpu_capture — present only on a CPU fallback: names the newest
                  committed BENCH_r*_local.json real-hardware capture.
 """
@@ -126,13 +132,14 @@ CHILD_BUDGET_S = {
     "ingest": 240,
     "wire_rpc": 300,
     "server_apply": 360,
+    "quant_wire": 420,
 }
 # run order = value order: the contract fields land first, platform-bound
 # numbers next, platform-independent ones last
 CHILD_ORDER = (
     "headline", "pipeline_e2e", "hbm_scale", "ladder", "scale", "word2vec",
     "matrix_fac", "darlin", "spmd_push", "wd_push", "ingest", "wire_rpc",
-    "server_apply",
+    "server_apply", "quant_wire",
 )
 
 
@@ -1472,6 +1479,130 @@ def child_server_apply() -> dict:
     return out
 
 
+def child_quant_wire() -> dict:
+    """Quantized push/pull wire A/B (ISSUE 6 acceptance cell): the
+    linear-method e2e workload (synthetic sparse logistic regression)
+    trained over the REAL wire tier (ShardServer + ServerHandle on
+    loopback) three times — float32, int8+error-feedback, int16 — with
+    identical seeds. Reports the measured push payload ratio (the
+    ``wire_push_payload_bytes`` counter, f32 / quantized; acceptance:
+    >= 3x at int8) and AUC per arm (progressive validation over the
+    stream's second half + a held-out slice scored against the final
+    pulled weights; acceptance: |dAUC| <= 0.002 at equal seeds)."""
+    from parameter_server_tpu.kv.updaters import Ftrl
+    from parameter_server_tpu.models import metrics as M
+    from parameter_server_tpu.parallel.multislice import ServerHandle, ShardServer
+    from parameter_server_tpu.utils.config import PSConfig
+    from parameter_server_tpu.utils.keyrange import KeyRange
+    from parameter_server_tpu.utils.metrics import wire_counters
+
+    n_keys = 1 << 14
+    nnz = NNZ_PER
+    bsz, n_batches, n_holdout = 2048, 20, 4096
+    rng = np.random.default_rng(23)
+    w_true = rng.normal(size=n_keys) * 1.2
+    n_total = bsz * n_batches + n_holdout
+    kb_all = rng.integers(0, n_keys, size=(n_total, nnz))
+    logits = w_true[kb_all].sum(axis=1) / np.sqrt(nnz)
+    y_all = (rng.random(n_total) < 1 / (1 + np.exp(-logits))).astype(
+        np.float64
+    )
+
+    def _arm(quant: str) -> dict:
+        srv = ShardServer(
+            # alpha/l1 sized for per-example-MEAN gradients on this
+            # workload (the localizer-normalized form): the default l1=1
+            # would pin every weight at zero and flatline the AUC both
+            # arms are compared on
+            Ftrl(alpha=1.0, beta=BETA, lambda_l1=1e-4, lambda_l2=L2),
+            KeyRange(0, n_keys + 1),
+        ).start()
+        cfg = PSConfig()
+        cfg.wire.quant = quant
+        h = ServerHandle(srv.address, 0, 0, cfg, range_size=n_keys + 1)
+        try:
+            # warmup: negotiation round trip AND one full-size push/pull
+            # so the server's pow-2 apply bucket compiles outside the
+            # timed window (arms would otherwise be ordering-biased)
+            warm = np.arange(1, n_keys + 1, dtype=np.int64)
+            h.push(warm, np.zeros(n_keys, np.float32))
+            h.pull(warm)
+            pay0 = wire_counters.get("wire_push_payload_bytes")
+            ys, ps = [], []
+            t0 = time.perf_counter()
+            for b in range(n_batches):
+                s = slice(b * bsz, (b + 1) * bsz)
+                kb, y = kb_all[s], y_all[s]
+                uniq, inv = np.unique(kb, return_inverse=True)
+                keys = (uniq + 1).astype(np.int64)  # row 0 = pad row
+                w = h.pull(keys).astype(np.float64)
+                logit_hat = w[inv.reshape(bsz, nnz)].sum(axis=1)
+                p = 1 / (1 + np.exp(-logit_hat))
+                err = p - y
+                g = np.zeros(len(uniq))
+                np.add.at(
+                    g, inv.reshape(bsz, nnz).ravel(), np.repeat(err, nnz)
+                )
+                h.push(keys, (g / bsz).astype(np.float32))
+                if b >= n_batches // 2:
+                    ys.append(y)
+                    ps.append(p)
+            dt = time.perf_counter() - t0
+            payload = wire_counters.get("wire_push_payload_bytes") - pay0
+            w_full = h.pull(
+                np.arange(1, n_keys + 1, dtype=np.int64)
+            ).astype(np.float64)
+            kb_h = kb_all[bsz * n_batches:]
+            p_h = 1 / (1 + np.exp(-w_full[kb_h].sum(axis=1)))
+            return {
+                "auc": round(
+                    float(M.auc(np.concatenate(ys), np.concatenate(ps))), 4
+                ),
+                "holdout_auc": round(
+                    float(M.auc(y_all[bsz * n_batches:], p_h)), 4
+                ),
+                "push_payload_mb": round(payload / 1e6, 3),
+                "ex_per_sec": round(bsz * n_batches / dt, 1),
+                "residual_peak_x1e6": wire_counters.get(
+                    "wire_quant_residual_peak"
+                ),
+            }
+        finally:
+            h.shutdown()
+            h.close()
+
+    out: dict = {"platform": "cpu-loopback", "config":
+                 f"keys=2^14 nnz={nnz} batches={n_batches}x{bsz} ftrl"}
+    # throwaway warmup arm: the seeds pin every batch's unique-key count,
+    # so one full pass compiles every eager gather/updater shape the
+    # measured arms will hit — without it the first arm eats them all and
+    # the ex_per_sec comparison is ordering, not codec
+    _arm("off")
+    arms = {}
+    for quant in ("off", "int8", "int16"):
+        wire_counters.reset()
+        arms[quant] = _arm(quant)
+    out["auc_f32"] = arms["off"]["auc"]
+    out["holdout_auc_f32"] = arms["off"]["holdout_auc"]
+    out["push_payload_mb_f32"] = arms["off"]["push_payload_mb"]
+    out["ex_per_sec_f32"] = arms["off"]["ex_per_sec"]
+    for quant in ("int8", "int16"):
+        a = arms[quant]
+        out[f"auc_{quant}"] = a["auc"]
+        out[f"holdout_auc_{quant}"] = a["holdout_auc"]
+        out[f"push_payload_mb_{quant}"] = a["push_payload_mb"]
+        out[f"ex_per_sec_{quant}"] = a["ex_per_sec"]
+        out[f"residual_peak_x1e6_{quant}"] = a["residual_peak_x1e6"]
+        out[f"push_bytes_ratio_{quant}"] = round(
+            arms["off"]["push_payload_mb"] / max(a["push_payload_mb"], 1e-9),
+            2,
+        )
+        out[f"auc_delta_{quant}"] = round(
+            abs(a["holdout_auc"] - arms["off"]["holdout_auc"]), 4
+        )
+    return out
+
+
 _CHILDREN = {
     "headline": child_headline,
     "pipeline_e2e": child_pipeline_e2e,
@@ -1486,6 +1617,7 @@ _CHILDREN = {
     "ingest": child_ingest,
     "wire_rpc": child_wire_rpc,
     "server_apply": child_server_apply,
+    "quant_wire": child_quant_wire,
 }
 
 
@@ -1613,18 +1745,22 @@ def main() -> None:
 
     results: dict = {}
     for name in CHILD_ORDER:
-        # wire_rpc/server_apply measure host TCP + updater latency, never
-        # the accelerator: pin them to CPU like the cpu-sim meshes so a
-        # wedged tunnel can't take the telemetry block down with it
+        # wire_rpc/server_apply/quant_wire measure host TCP + updater
+        # latency, never the accelerator: pin them to CPU like the
+        # cpu-sim meshes so a wedged tunnel can't take the telemetry
+        # block down with it
         child_env = (
             _cpu_sim_env()
-            if name in ("spmd_push", "wd_push", "wire_rpc", "server_apply")
+            if name in (
+                "spmd_push", "wd_push", "wire_rpc", "server_apply",
+                "quant_wire",
+            )
             else env
         )
         r = _run_child(name, child_env, CHILD_BUDGET_S[name])
         results[name] = r
         if "error" in r and not degraded and name not in (
-            "spmd_push", "wd_push", "wire_rpc", "server_apply"
+            "spmd_push", "wd_push", "wire_rpc", "server_apply", "quant_wire"
         ):
             # the accelerator may have wedged mid-suite: re-probe, and run
             # everything that's left on the CPU fallback if it's gone
@@ -1704,6 +1840,7 @@ def main() -> None:
             "ingest": results.get("ingest", {}),
             "wire_rpc": wire_rpc,
             "server_apply": results.get("server_apply", {}),
+            "quant_wire": results.get("quant_wire", {}),
         },
         "suite_wall_s": round(time.perf_counter() - t_start, 1),
         **extra,
@@ -1792,6 +1929,11 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
             "srv": _pick(
                 "server_apply", "batched_speedup_w8",
                 "push_rps_batched_w8", "hdr_speedup_4k"),
+            # the quantized wire's acceptance numbers (ISSUE 6): push
+            # wire-bytes ratio at int8 and AUC parity vs the float arm
+            "quant": _pick(
+                "quant_wire", "push_bytes_ratio_int8", "auc_delta_int8",
+                "holdout_auc_f32", "holdout_auc_int8"),
         },
     }
     if "last_tpu_capture" in full:

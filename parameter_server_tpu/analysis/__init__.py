@@ -11,6 +11,9 @@ invariants PRs 1-4 introduced:
                          all exit paths, exception edges included
     counter-contract     every bumped counter renders in cli stats
     config-contract      every cfg.<section>.<key> read has a default
+    replycache-contract  reply-cache exemption sets (idempotent/blocking/
+                         prio cmds) name only served commands, and every
+                         served command has a binary cmd id
     trace-hygiene        spans only via `with trace.span(...)` / @traced
     pragma-hygiene       every suppression carries a justification
 
@@ -48,6 +51,7 @@ from parameter_server_tpu.analysis.lockgraph import (
     build_lock_graph,
     check_lock_order,
 )
+from parameter_server_tpu.analysis.replycache import check_replycache_contract
 from parameter_server_tpu.analysis.settle import check_settle_exactly_once
 from parameter_server_tpu.analysis.tracehygiene import check_trace_hygiene
 
@@ -72,6 +76,7 @@ CHECKERS: dict[str, Checker] = {
     "settle-exactly-once": check_settle_exactly_once,
     "counter-contract": check_counter_contract,
     "config-contract": check_config_contract,
+    "replycache-contract": check_replycache_contract,
     "trace-hygiene": check_trace_hygiene,
     "pragma-hygiene": check_pragma_hygiene,
 }

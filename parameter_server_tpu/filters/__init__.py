@@ -16,3 +16,4 @@ is its scarce resource. On a TPU pod:
 
 from parameter_server_tpu.filters.fixed_point import FixedPointCodec  # noqa: F401
 from parameter_server_tpu.filters.frequency import CountMinSketch  # noqa: F401
+from parameter_server_tpu.filters.quant import SegmentQuantizer  # noqa: F401

@@ -1,0 +1,185 @@
+"""Per-segment-scale int8/int16 gradient quantizer — the wire codec.
+
+Generalizes :mod:`filters/fixed_point` (one min/max affine scale per whole
+array, the reference's fixing_float filter) into the production-grade form
+EQuARX-style gradient exchange uses: the payload is cut into fixed-length
+SEGMENTS and each segment carries its own symmetric scale, so one outlier
+coordinate no longer destroys the resolution of the other few hundred
+thousand (the reference's per-array scaling loses ~all mantissa bits on
+heavy-tailed FTRL gradients; per-segment scaling bounds the blast radius
+to ``seg`` coordinates).
+
+Design points, each load-bearing for the wire tier:
+
+- **Symmetric zero.** ``q = round(x / scale)`` with a per-segment scale of
+  ``max|x| / qmax`` maps 0.0 to exactly 0 — the KV store's pad-row
+  invariant (pad slots carry zero gradient, row 0 absorbs zero updates)
+  survives quantization bit-exactly. The affine (lo + scale*q) form of
+  ``FixedPointCodec`` does not guarantee this.
+- **Stochastic rounding.** ``E[decode(encode(x))] == x``: the server's
+  batched apply sees an unbiased gradient, which is what keeps
+  FTRL/AdaGrad trajectories statistically unchanged. The residual of each
+  *realized* rounding still lands in the client's error-feedback
+  accumulator (parallel/multislice.ServerHandle), so the bias AND the
+  variance are both compensated across steps.
+- **Wire shape.** ``encode`` returns ``q`` trimmed to the input's true
+  length (the zero-padding needed for the segment reshape never rides the
+  wire) plus one float32 scale per segment — at the default ``seg=256``
+  the scale overhead is 4/256 ≈ 1.6%, so int8 transport is a ~3.8x
+  payload reduction vs float32. Both arrays ride the binary header's
+  array-descriptor table like any other payload chunk (dtype + shape),
+  and the adaptive compression layer already skips int8/int16 chunks.
+- **No blocking calls.** The numpy fast path below runs on wire threads
+  (possibly under the handle's residual lock); it deliberately avoids
+  every primitive pslint's blocking-under-lock checker flags.
+
+The jitted jax twins (:func:`quantize_segments` / :func:`dequantize_
+segments`) are the device-path form (SPMD quantized push mode, tests
+assert numpy/jax parity); the host wire path uses the numpy
+implementation because per-push lengths are arbitrary (per-range key
+slices) and must not trigger a recompile per fresh shape.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+#: smallest representable scale: a segment of exact zeros must decode to
+#: exact zeros without a divide-by-zero on the encode side
+_TINY = 1e-30
+
+
+def _qmax(num_bytes: int) -> int:
+    return (1 << (8 * num_bytes - 1)) - 1  # 127 / 32767
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_cores(num_bytes: int, seg: int):
+    """Build (encode, decode) jitted jax cores for one codec geometry.
+    Inputs are pre-padded to a segment multiple; lazy so importing this
+    module never initializes jax."""
+    import jax
+    import jax.numpy as jnp
+
+    qmax = _qmax(num_bytes)
+    dtype = jnp.int8 if num_bytes == 1 else jnp.int16
+
+    @jax.jit
+    def enc(key, x):  # x: (nseg * seg,) f32, zero-padded
+        xs = x.reshape(-1, seg)
+        scale = jnp.maximum(jnp.max(jnp.abs(xs), axis=1) / qmax, _TINY)
+        t = xs / scale[:, None]
+        floor = jnp.floor(t)
+        frac = t - floor
+        up = jax.random.uniform(key, t.shape) < frac
+        q = jnp.clip(floor + up, -qmax, qmax).astype(dtype)
+        return q.reshape(-1), scale.astype(jnp.float32)
+
+    @jax.jit
+    def dec(q, scale):
+        qs = q.reshape(-1, seg).astype(jnp.float32)
+        return (qs * scale[:, None]).reshape(-1)
+
+    return enc, dec
+
+
+def quantize_segments(key, x, num_bytes: int = 1, seg: int = 256):
+    """Jitted device-path encode: ``x`` (flat f32, length a multiple of
+    ``seg``) -> (q, per-segment scales). ``key`` is a jax PRNG key."""
+    return _jit_cores(num_bytes, seg)[0](key, x)
+
+
+def dequantize_segments(q, scale, num_bytes: int = 1, seg: int = 256):
+    """Jitted device-path decode (inverse of :func:`quantize_segments`)."""
+    return _jit_cores(num_bytes, seg)[1](q, scale)
+
+
+@dataclass(frozen=True)
+class SegmentQuantizer:
+    """The host wire codec: int8/int16 payload + one f32 scale per ``seg``
+    coordinates, stochastic (unbiased) rounding on encode.
+
+    ``encode`` / ``decode`` are numpy-vectorized and shape-flexible
+    (arbitrary input lengths; the pad needed for the segment reshape is
+    internal and never serialized)."""
+
+    num_bytes: int = 1
+    seg: int = 256
+
+    def __post_init__(self) -> None:
+        if self.num_bytes not in (1, 2):
+            raise ValueError("num_bytes must be 1 or 2")
+        if self.seg < 1:
+            raise ValueError("seg must be >= 1")
+
+    @property
+    def qmax(self) -> int:
+        return _qmax(self.num_bytes)
+
+    @property
+    def dtype(self):
+        return np.int8 if self.num_bytes == 1 else np.int16
+
+    def _padded(self, x: np.ndarray) -> np.ndarray:
+        flat = x.astype(np.float32, copy=False).reshape(-1)
+        pad = (-len(flat)) % self.seg
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+        return flat
+
+    def encode(
+        self, seed: int, x: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Quantize ``x`` -> (q: int8/int16 (n,), scales: f32 (nseg,)).
+        ``seed`` feeds the stochastic-rounding RNG; distinct pushes must
+        use distinct seeds (the handle's atomic counter does)."""
+        n = int(np.size(x))
+        xs = self._padded(x).reshape(-1, self.seg)
+        scale = np.abs(xs).max(axis=1) / self.qmax
+        np.maximum(scale, _TINY, out=scale)
+        t = xs / scale[:, None]
+        floor = np.floor(t)
+        frac = t - floor
+        up = np.random.default_rng(seed).random(t.shape, dtype=np.float32)
+        q = floor + (up < frac)
+        np.clip(q, -self.qmax, self.qmax, out=q)
+        return (
+            q.reshape(-1)[:n].astype(self.dtype),
+            scale.astype(np.float32),
+        )
+
+    def encode_nearest(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Deterministic round-to-nearest encode (no seed) — the PULL
+        side's form: weight reads have no error-feedback loop to redeem
+        stochastic rounding's unbiasedness, so nearest halves the
+        worst-case error and keeps repeated reads of one unchanged
+        snapshot bit-identical (cacheable, diffable, reproducible)."""
+        n = int(np.size(x))
+        xs = self._padded(x).reshape(-1, self.seg)
+        scale = np.abs(xs).max(axis=1) / self.qmax
+        np.maximum(scale, _TINY, out=scale)
+        q = np.rint(xs / scale[:, None])
+        np.clip(q, -self.qmax, self.qmax, out=q)
+        return (
+            q.reshape(-1)[:n].astype(self.dtype),
+            scale.astype(np.float32),
+        )
+
+    def decode(self, q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+        """Dequantize -> flat float32 of ``q``'s length (the encode-side
+        pad was trimmed before the wire; re-pad, scale, trim again)."""
+        n = int(np.size(q))
+        flat = q.astype(np.float32, copy=False).reshape(-1)
+        pad = (-n) % self.seg
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+        out = flat.reshape(-1, self.seg) * scale[:, None].astype(np.float32)
+        return out.reshape(-1)[:n]
+
+    def wire_bytes(self, n: int) -> int:
+        """Payload bytes for an ``n``-coordinate push (q + scales)."""
+        nseg = -(-n // self.seg)
+        return n * self.num_bytes + 4 * nseg

@@ -31,6 +31,16 @@ binary, or JSON carrying ``_bh: 1``); only then does the connection
 switch — so a mixed-version cluster degrades to JSON instead of
 crashing an old peer. Servers simply echo the request's codec.
 
+Optional wire FEATURES (e.g. the quantized push codec, ``"qwire"``)
+negotiate per connection the same way: a client constructed with
+``features`` advertises them in a ``_feat`` header list (riding the
+binary codec's JSON tail) until a reply acks the intersection the server
+supports; ``RpcClient.peer_features`` is empty until then, so a feature
+user (ServerHandle's quantizer) stays on the baseline encoding against a
+peer that never acks — mixed clusters degrade, never corrupt. Like the
+codec advert, the negotiation restarts on every reconnect, so a
+downgraded replacement server demotes the connection automatically.
+
 The payload path is zero-copy end to end: ``send_frame``
 gathers the length word, the header, and each array's ``memoryview``
 straight into ``socket.sendmsg`` (no ``tobytes``/``join`` concatenation),
@@ -661,8 +671,14 @@ class RpcServer:
         lane_hi: int = 4,
         lane_lo: int = 16,
         withheld_max_bytes: int = 8 << 20,
+        features: frozenset[str] = frozenset(),
     ):
         self._handler = handler
+        # optional wire features this server's handler understands (e.g.
+        # "qwire"): replies ack the intersection with a client's _feat
+        # advert, never more — the negotiation contract that lets a
+        # quantized client degrade to floats against an old server
+        self._features = frozenset(features)
         # reply priority lanes: replies to prio_cmds flush first (and at a
         # tighter withheld bound) so a control ack sharing the connection
         # never queues behind a multi-MiB coalesced pull reply
@@ -733,8 +749,10 @@ class RpcServer:
         hi_frames = lo_frames = 0
         # deferred replies (batched apply): settled before this thread
         # blocks on the socket, so an acked push is always applied;
-        # entries are (seq, deferred, cmd, t_svc, bin_hdr, advert)
-        deferred: list[tuple[Any, DeferredReply, str, float, bool, bool]] = []
+        # entries are (seq, deferred, cmd, t_svc, bin_hdr, advert, feats)
+        deferred: list[
+            tuple[Any, DeferredReply, str, float, bool, bool, list | None]
+        ] = []
 
         def queue_reply(
             rep: dict[str, Any], rep_arrays: Arrays | None,
@@ -766,18 +784,22 @@ class RpcServer:
             hi_frames = lo_frames = 0
 
         def decorated(
-            rep: dict[str, Any], seq_d: Any, adv_d: bool
+            rep: dict[str, Any], seq_d: Any, adv_d: bool,
+            feat_d: list | None = None,
         ) -> dict[str, Any]:
             """One copy of the reply decoration: echo the request's seq
-            (``_rseq``) and/or ack the codec advert (``_bh``) on a COPY —
-            ``rep`` may be a shared reply-cache dict."""
-            if seq_d is None and not adv_d:
+            (``_rseq``), ack the codec advert (``_bh``) and/or the
+            feature advert (``_feat``) on a COPY — ``rep`` may be a
+            shared reply-cache dict."""
+            if seq_d is None and not adv_d and feat_d is None:
                 return rep
             rep = dict(rep)
             if seq_d is not None:
                 rep["_rseq"] = seq_d
             if adv_d:
                 rep["_bh"] = 1
+            if feat_d is not None:
+                rep["_feat"] = feat_d
             return rep
 
         def settle_deferred() -> None:
@@ -788,7 +810,7 @@ class RpcServer:
             drain sees exactly the entries whose replies were never
             queued — none stranded, none double-counted."""
             while deferred:
-                seq_d, d, cmd_d, t_d, bin_d, adv_d = deferred[0]
+                seq_d, d, cmd_d, t_d, bin_d, adv_d, feat_d = deferred[0]
                 try:
                     rep_d, arrays_d = d.future.result()
                 except ConnectionError:
@@ -809,7 +831,7 @@ class RpcServer:
                     f"server.{cmd_d}", time.perf_counter() - t_d
                 )
                 queue_reply(
-                    decorated(rep_d, seq_d, adv_d), arrays_d,
+                    decorated(rep_d, seq_d, adv_d, feat_d), arrays_d,
                     hi=False, bin_hdr=bin_d,
                 )
         with self._counter_lock:
@@ -855,6 +877,17 @@ class RpcServer:
                 # a JSON request advertising _bh gets _bh acked back so the
                 # client knows it may switch this connection to binary
                 advert = bool(header.pop("_bh", False)) and not was_bin
+                # feature negotiation: ack the intersection of the
+                # client's advertised features with what this server's
+                # handler actually understands (an old client sends no
+                # _feat and gets no ack; an old server leaves _feat in
+                # the header, which every handler ignores)
+                feat_req = header.pop("_feat", None)
+                feat_ack = (
+                    sorted(self._features.intersection(feat_req))
+                    if isinstance(feat_req, (list, tuple))
+                    else None
+                )
                 cmd_name = header.get("cmd", "?")
                 # copy BEFORE dispatch: handlers mutate the header (pop cmd)
                 dup_header = (
@@ -891,8 +924,8 @@ class RpcServer:
                     try:
                         settle_deferred()
                         queue_reply(
-                            decorated({"ok": True}, seq, advert), None,
-                            hi=True, bin_hdr=was_bin,
+                            decorated({"ok": True}, seq, advert, feat_ack),
+                            None, hi=True, bin_hdr=was_bin,
                         )
                         flush_replies()
                     finally:
@@ -918,7 +951,7 @@ class RpcServer:
                     return  # applied, but the reply is lost; conn closed below
                 if isinstance(rep, DeferredReply):
                     deferred.append(
-                        (seq, rep, cmd_name, t_svc, was_bin, advert)
+                        (seq, rep, cmd_name, t_svc, was_bin, advert, feat_ack)
                     )
                     if len(deferred) >= 64:  # bound parked futures
                         settle_deferred()
@@ -926,7 +959,7 @@ class RpcServer:
                     # the seq echo lets a pipelined client match this
                     # reply to the right in-flight future
                     queue_reply(
-                        decorated(rep, seq, advert), rep_arrays,
+                        decorated(rep, seq, advert, feat_ack), rep_arrays,
                         hi=cmd_name in self._prio_cmds, bin_hdr=was_bin,
                     )
                 # flush when input drains — or at a lane bound: withheld
@@ -1115,6 +1148,7 @@ class RpcClient:
         window: int = 8,
         hdr_codec: str = "bin",
         adaptive_window: bool = False,
+        features: frozenset[str] | tuple = (),
     ):
         """``cid``/``start_seq`` transfer a logical client identity into a
         rebuilt connection (ServerHandle recovery): the server's dedup
@@ -1131,7 +1165,11 @@ class RpcClient:
         ``adaptive_window=True`` derives the EFFECTIVE in-flight window
         from this client's completion-latency histogram: halve on a p99
         blowup, creep back up while latency is healthy and the window is
-        saturated. ``window`` stays the hard ceiling."""
+        saturated. ``window`` stays the hard ceiling.
+
+        ``features`` are optional wire capabilities to negotiate (the
+        ``_feat`` advert): ``peer_features`` stays empty until a reply
+        acks what the server supports, and resets on every reconnect."""
         self._address = address
         self._cid = cid or uuid.uuid4().hex[:16]
         self._next_seq = start_seq
@@ -1140,6 +1178,9 @@ class RpcClient:
         self._hdr_bin = hdr_codec == "bin"
         self._bin_gen_ok = False  # this connection negotiated binary
         self._rseq_gen_ok = False  # peer echoes _rseq on this connection
+        self._features = frozenset(features)
+        self._peer_features: frozenset[str] = frozenset()
+        self._feat_gen_ok = False  # peer acked _feat on this connection
         self._adaptive = bool(adaptive_window)
         self._eff_window = self._window
         self._lat_hist = Histogram()  # this client's own completions
@@ -1190,6 +1231,8 @@ class RpcClient:
         self._gen += 1
         self._bin_gen_ok = False  # codec re-negotiates per connection
         self._rseq_gen_ok = False  # until the peer proves it echoes seqs
+        self._feat_gen_ok = False  # features re-negotiate per connection
+        self._peer_features = frozenset()
         self._sock = sock
         threading.Thread(
             target=self._read_loop, args=(sock, self._gen), daemon=True
@@ -1217,6 +1260,7 @@ class RpcClient:
                 break
             p: _PendingCall | None = None
             bin_ok = was_bin or bool(rep.pop("_bh", False))
+            feat_ack = rep.pop("_feat", None)
             with self._cv:
                 if self._closed or self._gen != gen:
                     return  # stale reader: a heal already replaced this conn
@@ -1224,6 +1268,11 @@ class RpcClient:
                     # the peer proved it decodes binary (replied binary,
                     # or acked our _bh advert): switch this connection
                     self._bin_gen_ok = True
+                if feat_ack is not None and not self._feat_gen_ok:
+                    # the peer named the features it supports: the
+                    # connection may use exactly those from here on
+                    self._peer_features = frozenset(feat_ack)
+                    self._feat_gen_ok = True
                 self.bytes_in += nbytes
                 seq = rep.pop("_rseq", None)
                 if seq is not None:
@@ -1303,6 +1352,14 @@ class RpcClient:
         adaptive_window is shaping it)."""
         with self._cv:
             return self._eff_window
+
+    @property
+    def peer_features(self) -> frozenset[str]:
+        """Features the CURRENT connection's peer acked (empty until the
+        first ack, and after every reconnect until re-negotiated) —
+        callers must treat an empty set as 'assume the baseline wire'."""
+        with self._cv:
+            return self._peer_features
 
     def _conn_died(self, sock: socket.socket, gen: int) -> None:
         """A connection failed under its reader (or a sender): tear it
@@ -1483,6 +1540,11 @@ class RpcClient:
                     # codec advert: ask the peer to confirm binary headers
                     # (ignored by old servers, acked by new ones)
                     header["_bh"] = 1
+                if self._features and not self._feat_gen_ok:
+                    # feature advert (see __init__): repeats until the
+                    # first ack; old servers leave it in the header,
+                    # where every handler ignores it
+                    header["_feat"] = sorted(self._features)
                 if ctx is not None:
                     header["_trace"] = ctx
                 p = _PendingCall(_seq, cmd, header, arrays, _retry)

@@ -21,6 +21,22 @@ The reference's message filters come back to life on this wire
 (src/filter/): key caching (send a signature instead of the key list when
 the server has seen it), zlib compression of payload blocks, and
 fixed-point float truncation with stochastic rounding (filters/fixed_point).
+
+Quantized transport (``[wire] quant = int8|int16``, filters/quant.py): a
+push's gradient rides as a per-segment-scale integer payload (~3.8x fewer
+bytes at int8) with CLIENT-SIDE ERROR FEEDBACK — the residual each
+quantized push loses to rounding is folded into the next push of the same
+keys, so the server's (stochastically rounded, unbiased) applies converge
+to the float trajectory. The feature negotiates per connection (the
+``_feat``/"qwire" advert): against a server that never acks, the handle
+transparently stays on the float path — and flushes any accumulated
+residual into its next float push, so no gradient mass is ever stranded
+by a mid-run downgrade. Residual folding happens exactly once per LOGICAL
+push, at encode time: transport-level resends and the ``"k<n>"``
+keyed-seq recovery path reuse the already-encoded payload, so chaos
+(drop/disconnect/duplicate) can never double-fold an accumulator.
+``[wire] quant_pull`` extends the codec to pull replies (read-mostly
+serving traffic; no feedback loop, so it is opt-in).
 """
 
 from __future__ import annotations
@@ -182,6 +198,15 @@ class ShardServer:
         self._key_cache = _LruSigs()  # (worker, sig) -> key array
         self._lock = threading.Lock()
         self._max_batch = max(1, int(scfg.max_batch))
+        # adaptive batch ceiling (scfg.adaptive_batch): ramp the drain
+        # bound to the observed arrival rate — double while batches fill
+        # and the queue stays hot, halve when arrivals go sparse;
+        # max_batch stays the hard ceiling
+        self._adaptive_batch = bool(scfg.adaptive_batch)
+        self._eff_batch = (
+            min(4, self._max_batch) if self._adaptive_batch
+            else self._max_batch
+        )
         self._apply_q: queue_mod.Queue[_QueuedPush] | None = (
             queue_mod.Queue(maxsize=int(scfg.apply_queue))
             if scfg.apply_queue > 0
@@ -219,6 +244,9 @@ class ShardServer:
             lane_hi=scfg.lane_hi,
             lane_lo=scfg.lane_lo,
             withheld_max_bytes=scfg.withheld_max_mb << 20,
+            # this server decodes the per-segment quantized codec: acking
+            # "qwire" is what lets a quantized client leave the float path
+            features=frozenset({"qwire"}),
         )
         # bind and advertise may differ: bind 0.0.0.0 to accept remote
         # workers, advertise a routable hostname via the coordinator KV
@@ -329,11 +357,14 @@ class ShardServer:
             except queue_mod.Empty:
                 continue
             batch = [first]
-            while len(batch) < self._max_batch:
+            limit = self._eff_batch if self._adaptive_batch else self._max_batch
+            while len(batch) < limit:
                 try:
                     batch.append(q.get_nowait())
                 except queue_mod.Empty:
                     break
+            if self._adaptive_batch:
+                self._adapt_batch(len(batch), q.qsize())
             try:
                 self._apply_batch(batch)
             except Exception:  # noqa: BLE001 — isolate the offender
@@ -359,6 +390,24 @@ class ShardServer:
                 time.sleep(0.05)
                 continue
             self._fail_stopping(p)
+
+    def _adapt_batch(self, got: int, backlog: int) -> None:
+        """Adaptive batch-ceiling policy (``[server] adaptive_batch``),
+        called by the apply thread after each drain with the batch it
+        actually collected and the queue depth left behind. A FULL batch
+        with more still queued means arrivals outpace the ceiling —
+        double it (the drain is leaving coalescing wins on the table); a
+        batch far below the ceiling means arrivals are sparse — halve it,
+        so one slow client's trickle is applied at low latency instead of
+        waiting to fill a ceiling sized for a burst. Every change bumps
+        ``server_batch_adapts``; ``max_batch`` stays the hard ceiling."""
+        eff = self._eff_batch
+        if got >= eff and backlog > 0 and eff < self._max_batch:
+            self._eff_batch = min(eff * 2, self._max_batch)
+        elif got <= max(1, eff // 4) and eff > 1:
+            self._eff_batch = max(1, eff // 2)
+        if self._eff_batch != eff:
+            wire_counters.inc("server_batch_adapts")
 
     def _apply_batch(self, batch: list[_QueuedPush]) -> None:
         """Coalesce and apply one batch: segment-sum duplicate keys across
@@ -588,6 +637,29 @@ class ShardServer:
             rows = {k: v[keys] for k, v in state.items()}
             w = np.asarray(self.updater.weights(rows)).reshape(len(keys), -1)
             self._bump("pulls")
+            qn = int(h.get("quant", 0))
+            if qn:
+                # quantized pull (read-mostly/serving traffic): the rows
+                # ride as per-segment-scale integers at the width the
+                # client asked for. Only quant-negotiated clients send
+                # the field, so an old client can never receive a
+                # payload it can't decode. Round-to-NEAREST, not
+                # stochastic: reads have no error-feedback loop, so
+                # nearest halves the worst-case error and keeps repeated
+                # reads of one unchanged snapshot bit-identical.
+                from parameter_server_tpu.filters.quant import (
+                    SegmentQuantizer,
+                )
+
+                qz = SegmentQuantizer(qn, int(h.get("qseg", 256)))
+                q, qs = qz.encode_nearest(w.ravel())
+                wire_counters.inc(
+                    "wire_quant_bytes_saved",
+                    max(w.nbytes - q.nbytes - qs.nbytes, 0),
+                )
+                return {"ok": True, "codec": qn, "qseg": qz.seg}, {
+                    "q": q, "qs": qs,
+                }
             return {"ok": True, "zip": h.get("zip", False)}, {"w": w.ravel()}
         if cmd == "push":
             cid = h.get("_cid")
@@ -679,6 +751,18 @@ class ShardServer:
         codec_bytes = int(h.get("codec", 0))
         if not codec_bytes:
             return arrays["g"]
+        if "qs" in arrays:
+            # per-segment-scale codec (filters/quant.py, the negotiated
+            # "qwire" path): dequantize here on the serving thread — the
+            # decoded float grad then enters the apply queue, where
+            # coalesce_pushes segment-sums it into the engine's single
+            # jitted dispatch like any other push
+            from parameter_server_tpu.filters.quant import SegmentQuantizer
+
+            qz = SegmentQuantizer(codec_bytes, int(h.get("qseg", 256)))
+            return qz.decode(arrays["q"], arrays["qs"])
+        # legacy whole-array affine codec (filters/fixed_point, the
+        # un-negotiated [filter] fixing_float_bytes knob)
         from parameter_server_tpu.filters.fixed_point import Encoded, FixedPointCodec
 
         codec = FixedPointCodec(num_bytes=codec_bytes)
@@ -723,11 +807,41 @@ class ServerHandle:
         self._pipeline_window = max(1, cfg.wire.window)
         self._hdr_codec = cfg.wire.hdr_codec
         self._adaptive_window = cfg.wire.adaptive_window
+        # quantized push transport ([wire] quant, filters/quant.py):
+        # negotiated per connection via the "qwire" feature advert —
+        # until (unless) the peer acks, pushes stay on the float path
+        qmode = cfg.wire.quant
+        if qmode not in ("off", "int8", "int16"):
+            raise ValueError(
+                f"[wire] quant must be off|int8|int16, got {qmode!r}"
+            )
+        self._quant_bytes = {"off": 0, "int8": 1, "int16": 2}[qmode]
+        self._quant_pull = bool(cfg.wire.quant_pull) and self._quant_bytes > 0
+        self._features = (
+            frozenset({"qwire"}) if self._quant_bytes else frozenset()
+        )
+        if self._quant_bytes:
+            from parameter_server_tpu.filters.quant import SegmentQuantizer
+
+            self._quantizer = SegmentQuantizer(
+                self._quant_bytes, max(1, int(cfg.wire.quant_seg))
+            )
+        # error-feedback accumulator: the residual each quantized push
+        # loses to rounding, folded into the NEXT push of the same keys.
+        # Folded exactly once per logical push at encode time (resends
+        # reuse the encoded payload), guarded by its own lock so a
+        # recovery-thread re-encode can never race the worker loop.
+        self._res_lock = threading.Lock()
+        self._residual: np.ndarray | None = None
+        self._res_vdim = 0
+        self._res_range = int(range_size)
+        self._res_map: dict[int, int] | None = None
         self.client = RpcClient(
             address, reconnect_timeout_s=self._client_window_s,
             window=self._pipeline_window,
             hdr_codec=self._hdr_codec,
             adaptive_window=self._adaptive_window,
+            features=self._features,
         )
         # a worker's pull and in-flight push threads share this handle;
         # concurrent failures must rebuild the connection once — the
@@ -843,6 +957,10 @@ class ServerHandle:
                         window=self._pipeline_window,
                         hdr_codec=self._hdr_codec,
                         adaptive_window=self._adaptive_window,
+                        # feature negotiation restarts with the rebuilt
+                        # connection: a downgraded replacement server
+                        # simply never acks, and pushes drop to floats
+                        features=self._features,
                     )
                     self._sent_sigs = _LruSigs()
                     self._conn_gen += 1
@@ -988,7 +1106,9 @@ class ServerHandle:
         ):
             flow = trace.flow_start("ps.pull.inflight", cat="ps")
             ctx = trace.wire_context()
-            inner = self._keyed_call_async("pull", local_keys, {})
+            inner = self._keyed_call_async(
+                "pull", local_keys, {}, **self._pull_fields()
+            )
 
         def done(f) -> None:
             # nothing may escape (see _keyed_call_async.on_reply): a
@@ -1000,7 +1120,7 @@ class ServerHandle:
                         "ps.pull.inflight", cat="ps", flow_id=flow
                     )
                 _, out = f.result()
-                out_f.set_result(out["w"].astype(np.float32))
+                out_f.set_result(self._decode_pull(out))
             except BaseException as e:  # noqa: BLE001 — future boundary
                 if not out_f.done():
                     out_f.set_exception(e)
@@ -1017,7 +1137,7 @@ class ServerHandle:
         if len(local_keys) == 0:
             done_f.set_result(None)
             return done_f
-        fields, arrays = self._encode_push(grads)
+        fields, arrays = self._encode_push(local_keys, grads)
         with trace.span(
             "ps.push", cat="ps", rank=self.rank, keys=len(local_keys),
             bytes=int(sum(a.nbytes for a in arrays.values())),
@@ -1044,26 +1164,176 @@ class ServerHandle:
         inner.add_done_callback(done)
         return done_f
 
-    def _encode_push(self, grads: np.ndarray) -> tuple[dict[str, Any], Arrays]:
+    # -- error-feedback accumulator (quantized transport) ------------------
+
+    #: above this many rows the accumulator switches from a dense
+    #: range-indexed array to a compact touched-keys-only map — a sparse
+    #: workload on a 10^9-key shard must not allocate the whole range
+    #: client-side just because one high key was pushed
+    _DENSE_RESIDUAL_ROWS = 1 << 22
+
+    def _res_rows(self, keys: np.ndarray, vdim: int) -> np.ndarray:
+        """Row indices into the residual buffer for ``keys``, allocating
+        as needed (caller holds ``_res_lock``). Small known ranges index
+        the buffer by the range-relative key directly (vectorized);
+        large or unknown ranges go through a compact key->row map, so
+        memory is bounded by TOUCHED keys, never the range."""
+        if self._residual is None or self._res_vdim != vdim:
+            self._residual = np.zeros((0, vdim), np.float32)
+            self._res_vdim = vdim
+            self._res_map = (
+                None
+                if 0 < self._res_range <= self._DENSE_RESIDUAL_ROWS
+                else {}
+            )
+        if self._res_map is None:
+            rows = keys
+            hi = int(keys.max()) + 1 if len(keys) else 0
+        else:
+            m = self._res_map
+            rows = np.empty(len(keys), np.int64)
+            for i, k in enumerate(keys.tolist()):
+                j = m.get(k)
+                if j is None:
+                    j = m[k] = len(m)
+                rows[i] = j
+            hi = len(m)
+        if hi > len(self._residual):
+            grown = np.zeros(
+                (max(hi, 2 * len(self._residual)), vdim), np.float32
+            )
+            grown[: len(self._residual)] = self._residual
+            self._residual = grown
+        return rows
+
+    def residual_rows(self, keys: np.ndarray) -> np.ndarray:
+        """Current residual rows for ``keys``, zeros where nothing
+        accumulated (observability + the tests' telescoping identity).
+        Strictly READ-ONLY: unlike ``_res_rows`` it never allocates map
+        entries or grows the buffer — a metrics loop sweeping the key
+        space must not inflate the accumulator it is observing."""
+        with self._res_lock:
+            if self._residual is None:
+                return np.zeros((len(keys), 1), np.float32)
+            out = np.zeros((len(keys), self._res_vdim), np.float32)
+            if self._res_map is None:
+                known = keys < len(self._residual)
+                out[known] = self._residual[keys[known]]
+            else:
+                m = self._res_map
+                for i, k in enumerate(keys.tolist()):
+                    j = m.get(k)
+                    if j is not None:
+                        out[i] = self._residual[j]
+            return out
+
+    def residual_norm(self) -> float:
+        """Mean |residual| over allocated rows (observability + tests)."""
+        with self._res_lock:
+            if self._residual is None:
+                return 0.0
+            n = (
+                len(self._res_map)
+                if self._res_map is not None
+                else len(self._residual)
+            )
+            if n == 0:
+                return 0.0
+            return float(np.abs(self._residual[:n]).mean())
+
+    def _encode_push(
+        self, local_keys: np.ndarray, grads: np.ndarray
+    ) -> tuple[dict[str, Any], Arrays]:
         """Apply the send filters to one push payload (shared by the sync
-        and async paths): optional fixed-point quantization, else f32."""
+        and async paths): the negotiated per-segment quantized codec with
+        error feedback, the legacy fixed-point filter, else f32.
+
+        Called exactly once per LOGICAL push — transport resends, the
+        need_keys bounce and the keyed-seq recovery path all reuse the
+        returned arrays — so the residual fold below happens exactly once
+        however chaotic the wire gets."""
         fields: dict[str, Any] = {"codec": 0}
-        if self._codec_bytes:
+        g = grads.astype(np.float32, copy=False).reshape(len(local_keys), -1)
+        if self._quant_bytes and "qwire" in self.client.peer_features:
+            with self._res_lock:
+                rows = self._res_rows(local_keys, g.shape[1])
+                g_tot = g + self._residual[rows]
+                q, qs = self._quantizer.encode(next(self._quant_seed), g_tot)
+                res = g_tot - self._quantizer.decode(q, qs).reshape(
+                    g_tot.shape
+                )
+                self._residual[rows] = res
+            arrays: Arrays = {"q": q, "qs": qs}
+            fields["codec"] = self._quant_bytes
+            fields["qseg"] = self._quantizer.seg
+            wire_counters.inc(
+                "wire_quant_bytes_saved",
+                max(int(g_tot.nbytes) - q.nbytes - qs.nbytes, 0),
+            )
+            # residual-norm gauge (micro-units, cluster-merged as a max):
+            # a growing peak means quantization error is accumulating
+            # faster than error feedback drains it
+            wire_counters.observe_max(
+                "wire_quant_residual_peak",
+                int(np.abs(res).mean() * 1e6),
+            )
+        elif self._quant_bytes:
+            # quant configured but the peer never acked "qwire" (old or
+            # downgraded server, or the pre-negotiation first frames):
+            # float path — flushing any residual accumulated before a
+            # downgrade so no gradient mass is ever stranded
+            with self._res_lock:
+                if self._residual is not None and len(self._residual):
+                    rows = self._res_rows(local_keys, g.shape[1])
+                    g = g + self._residual[rows]  # fresh buffer
+                    self._residual[rows] = 0.0
+                else:
+                    g = np.array(g, dtype=np.float32)  # own the buffer
+            arrays = {"g": g}
+        elif self._codec_bytes:
             import jax
 
             e = self._codec.encode(
                 jax.random.key(next(self._quant_seed)),
                 grads.astype(np.float32),
             )
-            arrays: Arrays = {
+            arrays = {
                 "q": np.asarray(e.q),
                 "lo": np.asarray(e.lo)[None],
                 "scale": np.asarray(e.scale)[None],
             }
             fields["codec"] = self._codec_bytes
         else:
-            arrays = {"g": grads.astype(np.float32)}
+            # own the buffer (np.array always copies): the async pipeline
+            # serializes at send — and heal RESEND — time, so aliasing
+            # the caller's gradient array would let a reused buffer
+            # silently corrupt an in-flight push
+            arrays = {"g": np.array(g, dtype=np.float32)}
+        # push payload accounting (pre-compression, keys excluded): the
+        # bench's wire-bytes ratio divides the float-path total by the
+        # quantized-path total on identical workloads
+        wire_counters.inc(
+            "wire_push_payload_bytes",
+            sum(int(a.nbytes) for a in arrays.values()),
+        )
         return fields, arrays
+
+    # -- quantized pull (read-mostly traffic) ------------------------------
+
+    def _pull_fields(self) -> dict[str, Any]:
+        """Extra pull request fields: ask for quantized rows only once
+        the peer negotiated the codec ([wire] quant_pull)."""
+        if self._quant_pull and "qwire" in self.client.peer_features:
+            return {"quant": self._quant_bytes, "qseg": self._quantizer.seg}
+        return {}
+
+    def _decode_pull(self, out: Arrays) -> np.ndarray:
+        """Decode one pull reply: quantized rows when the server sent
+        them, the float fallback otherwise (a non-quant server ignores
+        the ``quant`` field and replies floats — degrade, not corrupt)."""
+        if "q" in out:
+            return self._quantizer.decode(out["q"], out["qs"])
+        return out["w"].astype(np.float32)
 
     def pull(self, local_keys: np.ndarray) -> np.ndarray:
         if len(local_keys) == 0:
@@ -1071,14 +1341,16 @@ class ServerHandle:
         with trace.span(
             "ps.pull", cat="ps", rank=self.rank, keys=len(local_keys)
         ) as sp:
-            _, out = self._keyed_call("pull", local_keys, {})
-            sp.set(bytes=int(out["w"].nbytes))
-        return out["w"].astype(np.float32)
+            _, out = self._keyed_call(
+                "pull", local_keys, {}, **self._pull_fields()
+            )
+            sp.set(bytes=int(sum(a.nbytes for a in out.values())))
+        return self._decode_pull(out)
 
     def push(self, local_keys: np.ndarray, grads: np.ndarray) -> None:
         if len(local_keys) == 0:
             return
-        fields, arrays = self._encode_push(grads)
+        fields, arrays = self._encode_push(local_keys, grads)
         with trace.span(
             "ps.push", cat="ps", rank=self.rank, keys=len(local_keys),
             bytes=int(sum(a.nbytes for a in arrays.values())),
@@ -1106,6 +1378,20 @@ class ServerHandle:
 # node entry points (ref: main.cc role dispatch; spawned by launch_local or
 # the `cli node` subcommand — one process per node, like script/local.sh)
 # ---------------------------------------------------------------------------
+
+
+def _export_witness_env(child_env: dict) -> None:
+    """Arm the runtime lock-order witness in spawned children whenever
+    THIS process runs under it — whether it was armed by the
+    ``PS_LOCK_WITNESS`` env var (already inherited via the env copy) or
+    by an explicit ``witness.install()`` (the tier-1 conftest), which an
+    env copy alone would silently fail to propagate. Children arm at
+    package import (parallel/__init__), so every lock a spawned node
+    constructs is order-checked too."""
+    from parameter_server_tpu.analysis import witness
+
+    if witness.installed():
+        child_env[witness.ENV_VAR] = "1"
 
 
 class _RemoteBeatSink:
@@ -1603,6 +1889,7 @@ def launch_local(
     fault_plan: str = "",
     fault_seed: int = 0,
     trace_dir: str = "",
+    trace_sample: int = 1,
 ) -> dict[str, Any]:
     """Spawn scheduler + servers + workers as real processes on this host
     (ref: script/local.sh — the de-facto integration test harness).
@@ -1651,6 +1938,12 @@ def launch_local(
         # each process exports trace-<role>-<rank>-<pid>.json into this dir
         os.makedirs(trace_dir, exist_ok=True)
         child_env[trace.TRACE_DIR_ENV] = trace_dir
+        if trace_sample > 1:
+            # head sampling rides along: children keep whole traces or
+            # drop them, consistently with every other node (the
+            # decision is keyed off the trace id, not the process)
+            child_env[trace.TRACE_SAMPLE_ENV] = str(int(trace_sample))
+    _export_witness_env(child_env)
 
     import tempfile
 
@@ -1799,9 +2092,15 @@ def run_node(
     # name makes each node's export file self-describing
     tdir = cfg.trace.trace_dir or os.environ.get(trace.TRACE_DIR_ENV, "")
     if tdir:
+        # head-sampling rate: an explicit [trace] sample wins, else the
+        # inherited PS_TRACE_SAMPLE (launch_local's arming path)
+        sample = cfg.trace.sample
+        if sample <= 1:
+            sample = trace._env_sample()
         trace.configure(
             tdir, capacity=cfg.trace.capacity,
             process_name=f"{role}-{rank}",
+            sample=sample,
         )
     if role == "scheduler":
         host, port = scheduler.rsplit(":", 1)

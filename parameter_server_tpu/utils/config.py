@@ -198,6 +198,23 @@ class WireConfig:
     # support keeps receiving JSON) or "json" (wire format of PRs 0-3,
     # always understood)
     hdr_codec: str = "bin"
+    # quantized push transport (filters/quant.py): "off" sends float32
+    # gradients; "int8"/"int16" sends per-segment-scale quantized
+    # payloads with client-side error-feedback accumulators folding each
+    # push's quantization residual into the next. Negotiated per
+    # connection (the _feat advert, like the binary-header _bh): against
+    # a server that never acks quant support the client transparently
+    # stays on the float path — mixed clusters degrade, never corrupt.
+    quant: str = "off"
+    # quantizer segment length: one float32 scale rides the wire per this
+    # many gradient coordinates (256 => ~1.6% scale overhead on int8)
+    quant_seg: int = 256
+    # also quantize PULL replies (read-mostly/serving traffic): the
+    # server encodes the requested rows at the negotiated width. Off by
+    # default — pulls have no error-feedback loop, so this trades exact
+    # weight reads for wire bytes and belongs to serving tiers, not
+    # training convergence paths.
+    quant_pull: bool = False
 
 
 @dataclass
@@ -213,6 +230,12 @@ class ServerConfig:
     apply_queue: int = 256
     # max pushes coalesced into one updater apply
     max_batch: int = 64
+    # scale the EFFECTIVE batch ceiling to the observed arrival rate
+    # instead of always draining up to max_batch: the ceiling doubles
+    # while batches fill and the queue stays hot, halves when arrivals
+    # go sparse (adaptations counted in ``server_batch_adapts``).
+    # ``max_batch`` stays the hard ceiling.
+    adaptive_batch: bool = False
     # reply-coalescing lane bounds, in withheld frames per connection:
     # control replies (the hi lane) flush at lane_hi, bulk pull/push
     # replies (the lo lane) at lane_lo
@@ -275,6 +298,12 @@ class TraceConfig:
 
     trace_dir: str = ""  # "" = tracing disabled (the free no-op path)
     capacity: int = 65536  # span ring-buffer bound per process
+    # head-based trace sampling: record 1/N of TRACES (not spans), keyed
+    # off the trace id so the decision is consistent for every span of
+    # one logical operation across every process it touches — always-on
+    # tracing at production step rates keeps whole traces, never
+    # fragments. 1 (default) records everything.
+    sample: int = 1
 
 
 @dataclass

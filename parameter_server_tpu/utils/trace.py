@@ -59,9 +59,17 @@ from collections import deque
 from typing import Any, Callable
 
 TRACE_DIR_ENV = "PS_TRACE_DIR"
+TRACE_SAMPLE_ENV = "PS_TRACE_SAMPLE"
 
 #: ring-buffer default: ~64k spans x ~200 B/event ~= 13 MB ceiling per process
 DEFAULT_CAPACITY = 65536
+
+
+def _env_sample() -> int:
+    try:
+        return max(1, int(os.environ.get(TRACE_SAMPLE_ENV, "1") or 1))
+    except ValueError:
+        return 1
 
 _current = threading.local()  # .span: innermost live span (or remote parent)
 
@@ -97,6 +105,32 @@ class _NoopSpan:
 
 
 _NOOP = _NoopSpan()
+
+
+class _DroppedSpan:
+    """A span inside a head-DROPPED trace (``sample=1/N``): it keeps the
+    thread-local nesting and a real wire identity — descendants, instants
+    and remote callees all see the shared trace id and make the SAME drop
+    decision, so sampling keeps whole traces or none of one — but records
+    nothing into the buffer."""
+
+    __slots__ = ("trace_id", "span_id", "_prev")
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+
+    def set(self, **args: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_DroppedSpan":
+        self._prev = getattr(_current, "span", None)
+        _current.span = self
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        _current.span = self._prev
+        return False
 
 
 class Span:
@@ -196,15 +230,35 @@ class Tracer:
         trace_dir: str | None = None,
         capacity: int = DEFAULT_CAPACITY,
         process_name: str = "",
+        sample: int = 1,
     ):
         self._dir = trace_dir or None
         self._buf: deque[dict[str, Any]] = deque(maxlen=max(capacity, 1))
         self._lock = threading.Lock()
         self.process_name = process_name or f"proc-{os.getpid()}"
+        # head-based sampling: record 1 in ``sample`` TRACES, decided
+        # once per trace id — every process keyed the same way keeps the
+        # same traces, so always-on tracing at production step rates
+        # yields whole cross-process traces, never fragments
+        self._sample = max(1, int(sample))
 
     @property
     def enabled(self) -> bool:
         return self._dir is not None
+
+    @property
+    def sample(self) -> int:
+        return self._sample
+
+    def _keep(self, trace_id: str) -> bool:
+        """The head-sampling decision, a pure function of the trace id
+        (hex): consistent for every span of one trace in every process."""
+        if self._sample <= 1:
+            return True
+        try:
+            return int(trace_id[:8], 16) % self._sample == 0
+        except (ValueError, TypeError):
+            return True
 
     @property
     def trace_dir(self) -> str | None:
@@ -214,13 +268,19 @@ class Tracer:
 
     def span(self, name: str, cat: str = "", **args: Any):
         """Context manager for one span. Disabled path: returns the
-        process-global no-op singleton (no allocation)."""
+        process-global no-op singleton (no allocation). A trace the head
+        sampler drops gets a :class:`_DroppedSpan` instead — nesting and
+        propagation intact, nothing recorded."""
         if self._dir is None:
             return _NOOP
         cur = getattr(_current, "span", None)
-        if cur is not None:
-            return Span(self, name, cat, cur.trace_id, cur.span_id, args)
-        return Span(self, name, cat, _new_id(), None, args)
+        if cur is not None and cur.trace_id is not None:
+            trace_id, parent = cur.trace_id, cur.span_id
+        else:
+            trace_id, parent = _new_id(), None
+        if not self._keep(trace_id):
+            return _DroppedSpan(trace_id)
+        return Span(self, name, cat, trace_id, parent, args)
 
     def instant(self, name: str, cat: str = "", **args: Any) -> None:
         """Point-in-time annotation (retry fired, reconnect started);
@@ -228,7 +288,9 @@ class Tracer:
         if self._dir is None:
             return
         cur = getattr(_current, "span", None)
-        if cur is not None:
+        if cur is not None and cur.trace_id is not None:
+            if not self._keep(cur.trace_id):
+                return  # the instant belongs to a head-dropped trace
             args = {"trace_id": cur.trace_id, "parent_id": cur.span_id, **args}
         self._record({
             "name": name,
@@ -271,6 +333,13 @@ class Tracer:
         pass it straight back to ``flow_end``, which then no-ops)."""
         if self._dir is None:
             return None
+        cur = getattr(_current, "span", None)
+        if (
+            cur is not None
+            and cur.trace_id is not None
+            and not self._keep(cur.trace_id)
+        ):
+            return None  # head-dropped trace: flow_end no-ops on None
         fid = flow_id or _new_id()
         self._record_flow(name, cat, "s", fid, args)
         return fid
@@ -368,8 +437,9 @@ class Tracer:
 
 
 #: the process's tracer; armed at import when PS_TRACE_DIR is set so
-#: spawned children need no plumbing (the PS_FAULT_PLAN pattern)
-tracer = Tracer(os.environ.get(TRACE_DIR_ENV) or None)
+#: spawned children need no plumbing (the PS_FAULT_PLAN pattern);
+#: PS_TRACE_SAMPLE rides along for head sampling
+tracer = Tracer(os.environ.get(TRACE_DIR_ENV) or None, sample=_env_sample())
 
 _atexit_armed = False
 
@@ -396,12 +466,14 @@ def configure(
     trace_dir: str | None,
     capacity: int = DEFAULT_CAPACITY,
     process_name: str = "",
+    sample: int = 1,
 ) -> Tracer:
     """Replace the global tracer (arm with a dir, disarm with ``""``/
-    ``None``). The previous buffer is dropped — configure at process
-    start, before instrumented code runs."""
+    ``None``; ``sample=N`` records 1/N of traces, keyed off the trace
+    id). The previous buffer is dropped — configure at process start,
+    before instrumented code runs."""
     global tracer
-    tracer = Tracer(trace_dir or None, capacity, process_name)
+    tracer = Tracer(trace_dir or None, capacity, process_name, sample=sample)
     if tracer.enabled:
         _arm_atexit()
     return tracer

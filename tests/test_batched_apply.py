@@ -442,3 +442,68 @@ class TestAdaptiveWindow:
         finally:
             h.shutdown()
             h.close()
+
+
+class TestAdaptiveBatch:
+    """[server] adaptive_batch (ISSUE 6 satellite): the apply thread's
+    drain ceiling tracks the observed arrival rate; max_batch stays the
+    hard ceiling; every change bumps ``server_batch_adapts``."""
+
+    def test_off_by_default_ceiling_is_max_batch(self):
+        srv = _mk_server()
+        try:
+            assert srv._adaptive_batch is False
+            assert srv._eff_batch == srv._max_batch
+            assert ServerConfig().adaptive_batch is False
+        finally:
+            srv.server.stop()
+
+    def test_policy_doubles_on_hot_queue_and_halves_on_sparse(self):
+        srv = _mk_server(ServerConfig(adaptive_batch=True, max_batch=64))
+        try:
+            assert srv._eff_batch == 4  # ramp start, not the ceiling
+            srv._adapt_batch(got=4, backlog=3)  # full + backlog: double
+            assert srv._eff_batch == 8
+            assert wire_counters.get("server_batch_adapts") == 1
+            srv._adapt_batch(got=8, backlog=1)
+            srv._adapt_batch(got=16, backlog=9)
+            srv._adapt_batch(got=32, backlog=2)
+            assert srv._eff_batch == 64
+            srv._adapt_batch(got=64, backlog=5)  # at the hard ceiling
+            assert srv._eff_batch == 64
+            srv._adapt_batch(got=3, backlog=0)  # sparse: halve
+            assert srv._eff_batch == 32
+            srv._adapt_batch(got=40, backlog=0)  # mid-range: hold
+            assert srv._eff_batch == 32
+            assert wire_counters.get("server_batch_adapts") == 5
+        finally:
+            srv.server.stop()
+
+    def test_floor_is_one(self):
+        srv = _mk_server(ServerConfig(adaptive_batch=True, max_batch=8))
+        try:
+            for _ in range(10):
+                srv._adapt_batch(got=1, backlog=0)
+            assert srv._eff_batch == 1
+        finally:
+            srv.server.stop()
+
+    def test_adaptive_engine_still_exactly_once(self):
+        """Correctness under the ramp: a pipelined burst through an
+        adaptive engine applies every push exactly once."""
+        srv = _mk_server(ServerConfig(adaptive_batch=True, max_batch=32))
+        h = _mk_handle(srv)
+        try:
+            keys = np.arange(1, 65, dtype=np.int64)
+            futs = [
+                h.push_async(keys, np.full(64, 0.5, np.float32))
+                for _ in range(30)
+            ]
+            for f in futs:
+                f.result(timeout=30)
+            w = h.pull(keys)
+            np.testing.assert_allclose(w, -15.0, rtol=1e-6)
+            assert srv.counters["pushes"] == 30
+        finally:
+            h.shutdown()
+            h.close()

@@ -49,6 +49,15 @@ def _full(sub_overrides=None, **top):
                          "push_rps_4k_bin": 3621.1,
                          "hdr_speedup_4k": 1.38,
                          "hdr_bytes_saved": 97410},
+        "quant_wire": {"push_bytes_ratio_int8": 3.94,
+                       "push_bytes_ratio_int16": 1.99,
+                       "auc_delta_int8": 0.0001,
+                       "auc_delta_int16": 0.0,
+                       "holdout_auc_f32": 0.65,
+                       "holdout_auc_int8": 0.6501,
+                       "push_payload_mb_f32": 1.287,
+                       "push_payload_mb_int8": 0.327,
+                       "residual_peak_x1e6_int8": 4},
     }
     sub.update(sub_overrides or {})
     return {
@@ -74,9 +83,20 @@ class TestCompactContract:
             assert k in c, k
         assert set(c["sub"]) >= {"e2e", "ladder", "hbm", "scale", "w2v",
                                  "mf", "darlin", "spmd", "wd", "ingest",
-                                 "rpc", "srv"}
+                                 "rpc", "srv", "quant"}
         assert c["sub"]["srv"]["batched_speedup_w8"] == 3.61
         assert c["sub"]["srv"]["hdr_speedup_4k"] == 1.38
+
+    def test_quant_cell_reaches_the_line(self):
+        # the quantized wire's acceptance numbers (ISSUE 6) must ride
+        # the driver-recorded stdout line, not just the full file
+        c = bench._compact_contract(_full(), "f.json")
+        assert c["sub"]["quant"] == {
+            "push_bytes_ratio_int8": 3.94,
+            "auc_delta_int8": 0.0001,
+            "holdout_auc_f32": 0.65,
+            "holdout_auc_int8": 0.6501,
+        }
 
     def test_telemetry_block_reaches_the_line(self):
         c = bench._compact_contract(_full(), "f.json")

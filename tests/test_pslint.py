@@ -559,3 +559,134 @@ class TestStrayThreadFixture:
         t.start()
         t.join(timeout=5)
         assert done.is_set()
+
+
+# ---------------------------------------------------------------------------
+# replycache-contract (ISSUE 6): reply-cache exemption sets vs served cmds
+# ---------------------------------------------------------------------------
+
+_RC_BASE = """
+class S:
+    def __init__(self):
+        self.server = RpcServer(
+            self._handle,
+            idempotent_cmds=frozenset({"pull", "stats"}),
+            blocking_cmds=frozenset({"pull"}),
+        )
+
+    def _handle(self, h, arrays):
+        cmd = h["cmd"]
+        if cmd == "pull":
+            return {}, {}
+        if cmd == "push":
+            return {}, {}
+        if cmd == "stats":
+            return {}, {}
+        raise ValueError(cmd)
+
+
+_CMD_IDS = {c: i + 1 for i, c in enumerate(("pull", "push", "stats"))}
+"""
+
+
+class TestReplycacheContract:
+    def test_clean_inventory_passes(self):
+        assert _run(_RC_BASE, "replycache-contract") == []
+
+    def test_stale_exemption_fires(self):
+        src = _RC_BASE.replace('"pull", "stats"', '"pull", "stats", "gone"')
+        fs = _run(src, "replycache-contract")
+        assert fs and "'gone'" in fs[0].message
+        assert "idempotent_cmds" in fs[0].message
+
+    def test_stale_blocking_cmd_fires(self):
+        src = _RC_BASE.replace(
+            'blocking_cmds=frozenset({"pull"})',
+            'blocking_cmds=frozenset({"barrier"})',
+        )
+        fs = _run(src, "replycache-contract")
+        assert fs and "'barrier'" in fs[0].message
+
+    def test_served_cmd_without_binary_id_fires(self):
+        src = _RC_BASE.replace('"pull", "push", "stats"', '"pull", "stats"')
+        fs = _run(src, "replycache-contract")
+        assert fs and "'push'" in fs[0].message
+        assert "_CMD_IDS" in fs[0].message
+
+    def test_getattr_dispatch_via_cmd_methods(self):
+        src = """
+class C:
+    def __init__(self):
+        self.server = RpcServer(
+            self._handle, idempotent_cmds=frozenset({"beat", "stale"}),
+        )
+
+    def _handle(self, h, arrays):
+        return getattr(self, "_cmd_" + h.pop("cmd"))(h, arrays)
+
+    def _cmd_beat(self, h, a):
+        return {}, {}
+"""
+        fs = _run(src, "replycache-contract")
+        assert len(fs) == 1 and "'stale'" in fs[0].message
+
+    def test_no_cmd_ids_table_skips_id_check(self):
+        src = _RC_BASE.split("_CMD_IDS")[0]
+        assert _run(src, "replycache-contract") == []
+
+    def test_real_package_inventories_nonvacuous(self):
+        """The derived inventories actually see the coordinator's and
+        the shard server's command tables (a regression that blinds the
+        checker would silently pass everything)."""
+        import ast as ast_mod
+
+        from parameter_server_tpu.analysis.core import load_package
+        from parameter_server_tpu.analysis.replycache import (
+            declared_sets,
+            served_cmds,
+        )
+
+        index = load_package()
+        by_cls = {}
+        for f in index.files:
+            for node in ast_mod.walk(f.tree):
+                if isinstance(node, ast_mod.ClassDef):
+                    by_cls[node.name] = node
+        coord = served_cmds(by_cls["Coordinator"])
+        shard = served_cmds(by_cls["ShardServer"])
+        assert {"barrier", "ssp_wait", "beat"} <= coord
+        assert {"pull", "push", "dump", "stats", "shutdown"} <= shard
+        assert declared_sets(by_cls["Coordinator"])
+        assert declared_sets(by_cls["ShardServer"])
+
+
+# ---------------------------------------------------------------------------
+# witness export through launch_local (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestWitnessExport:
+    def test_installed_witness_exports_env(self):
+        """launch_local children must run under the witness whenever the
+        parent does — including when the parent armed via an explicit
+        install() (tier-1 conftest), which a plain env copy would miss."""
+        from parameter_server_tpu.analysis import witness
+        from parameter_server_tpu.parallel.multislice import (
+            _export_witness_env,
+        )
+
+        env: dict = {}
+        assert witness.installed()  # the session fixture armed it
+        _export_witness_env(env)
+        assert env.get(witness.ENV_VAR) == "1"
+
+    def test_uninstalled_witness_leaves_env_alone(self, monkeypatch):
+        from parameter_server_tpu.analysis import witness
+        from parameter_server_tpu.parallel.multislice import (
+            _export_witness_env,
+        )
+
+        monkeypatch.setattr(witness, "installed", lambda: False)
+        env: dict = {}
+        _export_witness_env(env)
+        assert witness.ENV_VAR not in env

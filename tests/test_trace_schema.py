@@ -330,3 +330,106 @@ class TestTracerEnabled:
             e for e in pulls if e["args"]["trace_id"] == stp.trace_id
         ]
         assert joined[0]["args"]["parent_id"] == stp.span_id
+
+
+class TestHeadSampling:
+    """[trace] sample = 1/N (ISSUE 6 satellite): head-based, keyed off
+    the trace id — whole traces are kept or dropped, never fragments,
+    and the decision is reproducible across processes."""
+
+    def _root_ids(self, t):
+        return {
+            e["args"]["trace_id"]
+            for e in t.events()
+            if e.get("ph") == "X"
+        }
+
+    def test_sample_one_records_everything(self, tmp_path):
+        t = trace.configure(str(tmp_path), process_name="s1", sample=1)
+        try:
+            for _ in range(20):
+                with trace.span("root", cat="t"):
+                    pass
+            assert len(t.events()) == 20
+        finally:
+            trace.configure(None)
+
+    def test_sample_n_drops_whole_traces(self, tmp_path):
+        t = trace.configure(str(tmp_path), process_name="s4", sample=4)
+        try:
+            kept = 0
+            for _ in range(200):
+                with trace.span("root", cat="t"):
+                    with trace.span("child", cat="t"):
+                        trace.instant("tick", cat="t")
+                before = kept
+                kept = len(t.events())
+                # a trace contributes all three events or none: sampling
+                # never fragments one logical operation
+                assert kept - before in (0, 3)
+            # ~1/4 of 200 traces kept; generous bounds, id hash is uniform
+            assert 0 < kept // 3 < 150
+            # every recorded child belongs to a recorded root's trace
+            roots = {
+                e["args"]["trace_id"]
+                for e in t.events()
+                if e.get("ph") == "X" and e["name"] == "root"
+            }
+            for e in t.events():
+                assert e["args"]["trace_id"] in roots
+        finally:
+            trace.configure(None)
+
+    def test_decision_is_keyed_off_trace_id(self, tmp_path):
+        """The same trace id gets the same verdict in any process: a
+        remote child span under an activated context from a KEPT trace
+        records; under a DROPPED trace's context it does not."""
+        t = trace.configure(str(tmp_path), process_name="sk", sample=3)
+        try:
+            kept_ctx = dropped_ctx = None
+            while kept_ctx is None or dropped_ctx is None:
+                with trace.span("probe", cat="t") as sp:
+                    ctx = trace.wire_context()
+                if t._keep(sp.trace_id):
+                    kept_ctx = kept_ctx or ctx
+                else:
+                    dropped_ctx = dropped_ctx or ctx
+            n0 = len(t.events())
+            with trace.activate(dropped_ctx):
+                with trace.span("server.side", cat="t"):
+                    pass
+            assert len(t.events()) == n0  # dropped stays dropped remotely
+            with trace.activate(kept_ctx):
+                with trace.span("server.side", cat="t"):
+                    pass
+            assert len(t.events()) == n0 + 1
+        finally:
+            trace.configure(None)
+
+    def test_dropped_trace_flow_api_returns_none(self, tmp_path):
+        t = trace.configure(str(tmp_path), process_name="sf", sample=2)
+        try:
+            while True:
+                sp = trace.span("root", cat="t")
+                with sp:
+                    fid = trace.flow_start("f", cat="t")
+                    trace.flow_end("f", cat="t", flow_id=fid)
+                if not t._keep(sp.trace_id):
+                    break
+            assert all(
+                e["name"] != "f" or t._keep(e["args"]["trace_id"])
+                for e in t.events()
+            )
+        finally:
+            trace.configure(None)
+
+    def test_env_var_arms_sampling(self, monkeypatch):
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "8")
+        assert trace._env_sample() == 8
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "junk")
+        assert trace._env_sample() == 1
+
+    def test_config_knob_exists(self):
+        from parameter_server_tpu.utils.config import TraceConfig
+
+        assert TraceConfig().sample == 1
