@@ -90,6 +90,14 @@ Sub-benches ("sub"):
                  seeds; measured push payload ratio (>= 3x at int8) and
                  AUC parity (|dAUC| <= 0.002) per arm, plus the
                  residual-norm peak gauge.
+  serve        — online serving plane A/B (ISSUE 7 acceptance): 256
+                 simulated Zipf(1.1) read-mostly clients multiplexed
+                 over 16 threads against one shard server; cached
+                 (client versioned key cache + server single-flight
+                 encode coalescing) vs uncached pull QPS (>= 5x), cache
+                 hit rate, coalesce ratio, an int8 quant_pull arm, and
+                 a push-flood shed arm proving p99 stays bounded under
+                 admission control.
   last_tpu_capture — present only on a CPU fallback: names the newest
                  committed BENCH_r*_local.json real-hardware capture.
 """
@@ -133,13 +141,14 @@ CHILD_BUDGET_S = {
     "wire_rpc": 300,
     "server_apply": 360,
     "quant_wire": 420,
+    "serve": 300,
 }
 # run order = value order: the contract fields land first, platform-bound
 # numbers next, platform-independent ones last
 CHILD_ORDER = (
     "headline", "pipeline_e2e", "hbm_scale", "ladder", "scale", "word2vec",
     "matrix_fac", "darlin", "spmd_push", "wd_push", "ingest", "wire_rpc",
-    "server_apply", "quant_wire",
+    "server_apply", "quant_wire", "serve",
 )
 
 
@@ -1603,6 +1612,329 @@ def child_quant_wire() -> dict:
     return out
 
 
+#: the serve cell's shard server, run in its OWN process (real serving
+#: topology — a same-process server shares the client GIL and bottlenecks
+#: both arms on each other). Prints ADDR on bind; on shutdown prints one
+#: STATS line with its counters (incl. the server-side wire gauges the
+#: cell reports: withheld peak, quantized-pull bytes saved).
+_SERVE_SERVER_CODE = """
+import sys
+sys.path.insert(0, {repo!r})
+import json
+from parameter_server_tpu.kv.updaters import Sgd
+from parameter_server_tpu.parallel.multislice import ShardServer
+from parameter_server_tpu.utils.config import ServeConfig
+from parameter_server_tpu.utils.keyrange import KeyRange
+from parameter_server_tpu.utils.metrics import wire_counters
+
+scfg = ServeConfig(
+    cache=True, ttl_ms=1000, max_stale_ms=4000, hot_min_pulls=2,
+    encode_cache_entries={enc}, snapshot_keys_max={snap},
+    shed_queue_depth={shedq}, retry_after_ms=20,
+)
+srv = ShardServer(Sgd(eta=0.1), KeyRange(0, {nkeys}), serve_cfg=scfg)
+print("ADDR " + srv.address, flush=True)
+srv.serve_forever()
+stats = dict(srv.counters)
+stats["withheld_peak"] = wire_counters.get("wire_withheld_bytes_peak")
+stats["quant_bytes_saved"] = wire_counters.get("wire_quant_bytes_saved")
+print("STATS " + json.dumps(stats), flush=True)
+"""
+
+
+def child_serve() -> dict:
+    """Online serving plane A/B (ISSUE 7 acceptance cell): 256 simulated
+    read-mostly clients (32 per thread, each with its own Zipf(1.1)
+    stream over 512 hot key sets, multiplexed over 8 handle connections
+    per stack — the serving-frontend model: one shared cache per
+    frontend process, many users behind it) against shard servers in
+    their OWN processes, while a background writer churns versions
+    (~50 pushes/s, read-mostly). Blocks:
+
+      A/B     — INTERLEAVED rounds (median of per-round ratios, the
+                wire_rpc discipline: shared-host noise hits adjacent
+                rounds equally): baseline = the pre-serving-plane path
+                (no client cache, no server encode cache/snapshot) vs
+                cached = the full plane (client versioned key cache
+                with TTL 1s + if_newer revalidation + single-flight
+                refresh, server single-flight encode coalescing,
+                hot-key detection, per-version host weights snapshot).
+                hit_rate counts rows served from the local cache
+                (fresh TTL hits + bounded-stale rows served while
+                another thread's refresh was in flight).
+      int8    — cached + [wire] quant_pull: wire refreshes ride the
+                per-segment int8 codec (PR-6 carry-over: the codec now
+                has a serving workload exercising it).
+      shed    — cached under a push FLOOD with [serve] shed thresholds
+                armed: revalidations carrying a cached fallback get
+                retry-after instead of queueing behind the apply
+                engine; p99 and the withheld-bytes peak stay bounded.
+
+    Acceptance: cached pull QPS >= 5x baseline (median over rounds),
+    hit rate and coalesce ratio on the compact line, bounded shed p99."""
+    import statistics as stats_mod
+    import subprocess
+    import threading
+
+    from parameter_server_tpu.filters.keycache import ClientKeyCache
+    from parameter_server_tpu.parallel.multislice import ServerHandle
+    from parameter_server_tpu.utils.config import PSConfig, ServeConfig
+    from parameter_server_tpu.utils.metrics import wire_counters
+
+    n_keys = 1 << 15
+    n_sets, set_keys = 512, 32
+    n_threads, clients_per = 8, 32  # 256 simulated clients per stack
+    # a serving frontend is latency-bound on thread handoffs: the default
+    # 5ms GIL switch interval turns every future-wait wakeup into a
+    # convoy at p50 scale — tighten it for every arm alike
+    sys.setswitchinterval(0.001)
+    rng = np.random.default_rng(7)
+    keysets = [
+        np.sort(
+            rng.choice(np.arange(1, n_keys), size=set_keys, replace=False)
+        ).astype(np.int64)
+        for _ in range(n_sets)
+    ]
+    ranks = np.arange(1, n_sets + 1, dtype=np.float64)
+    pz = ranks ** -1.1  # Zipf(1.1) key-set popularity
+    pz /= pz.sum()
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    class _Stack:
+        """One serving stack: a shard server process + a frontend (8
+        handles sharing one cache when serving) + its churn writer."""
+
+        def __init__(
+            self, plane: bool, serving: bool, quant: str = "off",
+            shed: bool = False,
+        ):
+            self.scfg = ServeConfig(
+                cache=plane, ttl_ms=1000, max_stale_ms=4000, hot_min_pulls=2,
+                encode_cache_entries=256 if plane else 0,
+                snapshot_keys_max=(1 << 22) if plane else 0,
+                shed_queue_depth=4 if shed else 0, retry_after_ms=20,
+            )
+            self.shed = shed
+            self.proc = subprocess.Popen(
+                [sys.executable, "-c", _SERVE_SERVER_CODE.format(
+                    repo=repo, nkeys=n_keys,
+                    enc=self.scfg.encode_cache_entries,
+                    snap=self.scfg.snapshot_keys_max,
+                    shedq=self.scfg.shed_queue_depth,
+                )],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            line = self.proc.stdout.readline()
+            if not line.startswith("ADDR "):
+                err = (self.proc.stderr.read() or "no stderr").strip()[-400:]
+                raise RuntimeError(f"serve shard server: {err}")
+            addr = line.split()[1]
+            cfg = PSConfig()
+            cfg.serve = self.scfg
+            cfg.wire.quant = quant
+            cfg.wire.quant_pull = quant != "off"
+            shared = ClientKeyCache(
+                cap=self.scfg.cache_entries, ttl_s=self.scfg.ttl_ms / 1e3,
+                max_stale_s=self.scfg.max_stale_ms / 1e3,
+            )
+            self.handles = [
+                ServerHandle(
+                    addr, 0, t, cfg, range_size=n_keys, serving=serving,
+                    key_cache=shared,
+                )
+                for t in range(n_threads)
+            ]
+            self.writers = [
+                ServerHandle(addr, 0, 99 + i, PSConfig(), range_size=n_keys)
+                for i in range(2 if shed else 1)
+            ]
+            self.stop = threading.Event()
+            self.wthreads = [
+                threading.Thread(target=self._write_loop, args=(i,))
+                for i in range(len(self.writers))
+            ]
+            for th in self.wthreads:
+                th.start()
+
+        def _write_loop(self, wi: int) -> None:
+            wr = np.random.default_rng(11 + wi)
+            futs: list = []
+            while not self.stop.is_set():
+                ks = keysets[int(wr.integers(0, n_sets))]
+                g = (wr.normal(size=set_keys) * 0.01).astype(np.float32)
+                if self.shed:
+                    # flood: a window of async pushes keeps the apply
+                    # queue deep so the shed thresholds actually trip
+                    futs.append(self.writers[wi].push_async(ks, g))
+                    if len(futs) >= 32:
+                        for f in futs:
+                            f.result()
+                        futs.clear()
+                else:
+                    self.writers[wi].push(ks, g)  # read-mostly (~10/s)
+                    self.stop.wait(0.1)
+            for f in futs:
+                try:
+                    f.result()
+                except Exception:  # noqa: BLE001 — teardown race
+                    pass
+
+        def run_round(self, dur_s: float, seed: int) -> tuple[int, list]:
+            """Drive the frontend for one timed round; returns (pulls,
+            latencies). Each thread multiplexes its 32 clients round-
+            robin, every client on its own Zipf stream."""
+            lats: list[list[float]] = [[] for _ in range(n_threads)]
+            counts = [0] * n_threads
+
+            def loop(t: int) -> None:
+                crngs = [
+                    np.random.default_rng(seed + t * clients_per + c)
+                    for c in range(clients_per)
+                ]
+                picks = [
+                    crngs[c].choice(n_sets, size=64, p=pz)
+                    for c in range(clients_per)
+                ]
+                idx = [0] * clients_per
+                h = self.handles[t]
+                my = lats[t]
+                end = time.perf_counter() + dur_s
+                n = c = 0
+                while True:
+                    now = time.perf_counter()
+                    if now >= end:
+                        break
+                    c = (c + 1) % clients_per
+                    if idx[c] >= 64:
+                        picks[c] = crngs[c].choice(n_sets, size=64, p=pz)
+                        idx[c] = 0
+                    ks = keysets[int(picks[c][idx[c]])]
+                    idx[c] += 1
+                    h.pull(ks)
+                    my.append(time.perf_counter() - now)
+                    n += 1
+                counts[t] = n
+
+            ths = [
+                threading.Thread(target=loop, args=(t,))
+                for t in range(n_threads)
+            ]
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            return sum(counts), [x for sub in lats for x in sub]
+
+        def server_stats(self) -> dict:
+            return self.writers[0].stats()
+
+        def teardown(self) -> dict:
+            """Stop writers, shut the server down, return its final
+            counters (the STATS line it prints on exit)."""
+            self.stop.set()
+            for th in self.wthreads:
+                th.join()
+            for h in self.handles:
+                h.close()
+            try:
+                self.writers[0].shutdown()
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+            for w in self.writers:
+                w.close()
+            try:
+                sout, _ = self.proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                sout, _ = self.proc.communicate()
+            st = {"pull_encodes": 0, "encode_reuse": 0, "not_modified": 0,
+                  "shed": 0, "withheld_peak": 0, "quant_bytes_saved": 0}
+            for ln in sout.splitlines():
+                if ln.startswith("STATS "):
+                    st.update(json.loads(ln[6:]))
+            return st
+
+    def _pct(lat: list, p: float) -> float:
+        a = np.sort(np.asarray(lat))
+        return float(a[int(p * (len(a) - 1))]) * 1e3 if len(a) else 0.0
+
+    out: dict = {
+        "platform": "cpu-loopback",
+        "config": (
+            f"keys=2^15 sets={n_sets}x{set_keys} zipf=1.1 "
+            f"clients={n_threads * clients_per}/{n_threads}thr "
+            f"rounds=5x0.8s interleaved"
+        ),
+    }
+
+    # -- A/B: interleaved rounds over two live stacks ----------------------
+    base = _Stack(plane=False, serving=False)
+    cached = _Stack(plane=True, serving=True)
+    base.run_round(1.2, seed=1)  # warm: jit, negotiation, steady caches
+    cached.run_round(1.2, seed=1)
+    wire_counters.reset()
+    st0 = cached.server_stats()
+    qps_b, qps_c, lat_b, lat_c = [], [], [], []
+    total_c = 0
+    for r in range(5):
+        nb, lb = base.run_round(0.8, seed=10 + r)
+        nc, lc = cached.run_round(0.8, seed=10 + r)
+        qps_b.append(nb / 0.8)
+        qps_c.append(nc / 0.8)
+        lat_b += lb
+        lat_c += lc
+        total_c += nc
+    snap = wire_counters.snapshot()
+    base.teardown()
+    st1 = cached.teardown()
+    hits = (
+        snap.get("serve_cache_hits", 0)
+        + snap.get("serve_cache_stale_hits", 0)
+    )
+    enc = st1["pull_encodes"] - int(st0.get("pull_encodes", 0))
+    reuse = st1["encode_reuse"] - int(st0.get("encode_reuse", 0))
+    out["pull_qps_uncached"] = round(stats_mod.median(qps_b), 1)
+    out["pull_qps_cached"] = round(stats_mod.median(qps_c), 1)
+    out["qps_speedup_cached"] = round(stats_mod.median(
+        [c / max(b, 1e-9) for b, c in zip(qps_b, qps_c)]
+    ), 2)
+    out["p50_ms_uncached"] = round(_pct(lat_b, 0.50), 3)
+    out["p99_ms_uncached"] = round(_pct(lat_b, 0.99), 3)
+    out["p50_ms_cached"] = round(_pct(lat_c, 0.50), 3)
+    out["p99_ms_cached"] = round(_pct(lat_c, 0.99), 3)
+    out["hit_rate"] = round(hits / max(total_c, 1), 4)
+    out["fresh_hit_rate"] = round(
+        snap.get("serve_cache_hits", 0) / max(total_c, 1), 4
+    )
+    out["coalesce_ratio"] = round(reuse / max(reuse + enc, 1), 4)
+    out["not_modified"] = st1["not_modified"] - int(
+        st0.get("not_modified", 0)
+    )
+
+    # -- int8 quant_pull arm (PR-6 carry-over exercised) -------------------
+    wire_counters.reset()
+    q = _Stack(plane=True, serving=True, quant="int8")
+    q.run_round(1.0, seed=2)
+    n_q, lat_q = q.run_round(2.0, seed=20)
+    st_q = q.teardown()
+    out["pull_qps_int8"] = round(n_q / 2.0, 1)
+    out["p99_ms_int8"] = round(_pct(lat_q, 0.99), 3)
+    out["int8_wire_bytes_saved"] = st_q["quant_bytes_saved"]
+
+    # -- shed arm: push flood + admission control --------------------------
+    wire_counters.reset()
+    s = _Stack(plane=True, serving=True, shed=True)
+    s.run_round(1.0, seed=3)
+    n_s, lat_s = s.run_round(2.0, seed=30)
+    st_s = s.teardown()
+    out["pull_qps_shed"] = round(n_s / 2.0, 1)
+    out["p99_ms_shed"] = round(_pct(lat_s, 0.99), 3)
+    out["shed_count"] = st_s["shed"]
+    out["shed_served"] = wire_counters.get("serve_shed_served")
+    out["withheld_peak_shed"] = st_s["withheld_peak"]
+    return out
+
+
 _CHILDREN = {
     "headline": child_headline,
     "pipeline_e2e": child_pipeline_e2e,
@@ -1618,6 +1950,7 @@ _CHILDREN = {
     "wire_rpc": child_wire_rpc,
     "server_apply": child_server_apply,
     "quant_wire": child_quant_wire,
+    "serve": child_serve,
 }
 
 
@@ -1753,14 +2086,15 @@ def main() -> None:
             _cpu_sim_env()
             if name in (
                 "spmd_push", "wd_push", "wire_rpc", "server_apply",
-                "quant_wire",
+                "quant_wire", "serve",
             )
             else env
         )
         r = _run_child(name, child_env, CHILD_BUDGET_S[name])
         results[name] = r
         if "error" in r and not degraded and name not in (
-            "spmd_push", "wd_push", "wire_rpc", "server_apply", "quant_wire"
+            "spmd_push", "wd_push", "wire_rpc", "server_apply", "quant_wire",
+            "serve",
         ):
             # the accelerator may have wedged mid-suite: re-probe, and run
             # everything that's left on the CPU fallback if it's gone
@@ -1841,6 +2175,7 @@ def main() -> None:
             "wire_rpc": wire_rpc,
             "server_apply": results.get("server_apply", {}),
             "quant_wire": results.get("quant_wire", {}),
+            "serve": results.get("serve", {}),
         },
         "suite_wall_s": round(time.perf_counter() - t_start, 1),
         **extra,
@@ -1934,6 +2269,12 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
             "quant": _pick(
                 "quant_wire", "push_bytes_ratio_int8", "auc_delta_int8",
                 "holdout_auc_f32", "holdout_auc_int8"),
+            # the serving plane's acceptance numbers (ISSUE 7): cached
+            # pull QPS vs the uncached baseline at 256 Zipf clients,
+            # cache hit rate, encode-coalesce ratio, p99 under shedding
+            "serve": _pick(
+                "serve", "pull_qps_cached", "qps_speedup_cached",
+                "hit_rate", "coalesce_ratio", "p99_ms_shed"),
         },
     }
     if "last_tpu_capture" in full:

@@ -12,8 +12,13 @@ is its scarce resource. On a TPU pod:
   with the reference's randomized (unbiased) rounding.
 - snappy-style byte compression has no collective analog; omitted by
   design (recorded in PARITY.md).
+- on the cross-process wire the key-caching idea generalizes to the
+  VALUES themselves for read-mostly serving traffic: ``keycache.py``
+  holds a versioned client-side key->rows cache with TTL/revalidation
+  and exact push invalidation (the serving plane, ISSUE 7).
 """
 
 from parameter_server_tpu.filters.fixed_point import FixedPointCodec  # noqa: F401
 from parameter_server_tpu.filters.frequency import CountMinSketch  # noqa: F401
+from parameter_server_tpu.filters.keycache import ClientKeyCache  # noqa: F401
 from parameter_server_tpu.filters.quant import SegmentQuantizer  # noqa: F401

@@ -1,0 +1,236 @@
+"""Client-side versioned key-VALUE cache for the serving plane.
+
+Reference analog: src/filter/key_caching.h cached the key LISTS of a
+message so repeats send a signature instead of the keys. This module
+generalizes that idea to the values themselves for read-mostly (serving)
+traffic, the way the TeraByte-scale ads framework (arXiv 2201.05500)
+splits one parameter plane into a training path and a cached serving
+path: every pull reply carries the shard's RCU publish *version*, the
+client caches the decoded rows under the key-set signature, and a later
+pull of the same keys is served
+
+- **locally** while the entry is younger than the TTL (zero wire bytes),
+- **by revalidation** once the TTL lapses: an ``if_newer=<version>``
+  pull that comes back ``not_modified`` re-arms the TTL without moving
+  a single row byte,
+- **from the wire** only when the server's version actually moved.
+
+Invalidation is EXACT: a push through the owning handle invalidates
+every cached entry whose key set intersects the pushed keys (an
+inverted key -> signatures index makes that one dict probe per pushed
+key), so a client can never read its own write stale. Staleness against
+OTHER writers is bounded by ``ttl_ms`` — and by ``max_stale_ms`` as a
+hard ceiling when the server sheds revalidations under load.
+
+Thread safety: one lock around the map + inverted index. Nothing
+blocking ever runs under it (lookups, puts and invalidations are dict
+and small-array operations); the wire round trip always happens with
+the lock released, so a slow revalidation never parks concurrent local
+hits.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from parameter_server_tpu.utils.metrics import wire_counters
+
+
+class CacheEntry:
+    """One cached key set: the decoded float32 rows, the server version
+    they were read at, and the two clocks bounding how long they may be
+    served (``expires_at``: the soft TTL, re-armed by revalidation;
+    ``filled_at``: when the server last CONFIRMED this version, the
+    anchor of the hard ``max_stale`` ceiling)."""
+
+    __slots__ = ("keys", "values", "version", "filled_at", "expires_at")
+
+    def __init__(
+        self, keys: np.ndarray, values: np.ndarray, version: int,
+        filled_at: float, expires_at: float,
+    ):
+        self.keys = keys
+        self.values = values
+        self.version = version
+        self.filled_at = filled_at
+        self.expires_at = expires_at
+
+
+class ClientKeyCache:
+    """LRU of key-set signature -> :class:`CacheEntry` with an exact
+    inverted index (key -> signatures) driving push invalidation."""
+
+    def __init__(
+        self, cap: int = 1024, ttl_s: float = 0.05, max_stale_s: float = 0.5
+    ):
+        self.cap = max(1, int(cap))
+        self.ttl_s = float(ttl_s)
+        self.max_stale_s = float(max_stale_s)
+        self._lock = threading.Lock()
+        self._d: OrderedDict[str, CacheEntry] = OrderedDict()
+        self._by_key: dict[int, set[str]] = {}
+        # refresh coalescing: signatures with a revalidation in flight.
+        # While one caller refreshes a stale entry, concurrent pulls of
+        # the same keys serve the (within-max_stale) cached rows instead
+        # of issuing duplicate wire refreshes — ONE refresh per stale
+        # entry per expiry, however many threads share the cache.
+        self._refreshing: set[str] = set()
+        # invalidation generation: bumped by EVERY invalidate_keys call
+        # (even one that dropped nothing — the racing pull's entry may
+        # not be indexed yet). A put whose pull was issued before a
+        # later invalidation must lose, or a reply in flight across a
+        # concurrent push would re-install pre-push rows and this
+        # frontend would read its own write stale.
+        self._gen = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    @property
+    def gen(self) -> int:
+        """Current invalidation generation — capture BEFORE issuing a
+        wire pull and hand to :meth:`put` so an install can never race
+        past an invalidation (read-your-writes across threads)."""
+        with self._lock:
+            return self._gen
+
+    # -- reads -------------------------------------------------------------
+
+    def lookup(self, sig: str) -> CacheEntry | None:
+        """The entry for ``sig`` (LRU-touched), or None. The caller
+        decides freshness via :meth:`fresh` / :meth:`can_shed` — lookup
+        never drops a stale entry, because a stale entry still carries
+        the version that makes an if_newer revalidation cheap."""
+        with self._lock:
+            ent = self._d.get(sig)
+            if ent is not None:
+                self._d.move_to_end(sig)
+            return ent
+
+    def fresh(self, ent: CacheEntry, now: float | None = None) -> bool:
+        """Young enough to serve locally without any wire traffic."""
+        return (time.monotonic() if now is None else now) < ent.expires_at
+
+    def can_shed(self, ent: CacheEntry, now: float | None = None) -> bool:
+        """Young enough to keep serving if the server sheds the
+        revalidation (the hard staleness ceiling): the client advertises
+        ``shed_ok`` on the wire only while this holds, so an overloaded
+        server can never stretch a client past ``max_stale_s``."""
+        now = time.monotonic() if now is None else now
+        return now - ent.filled_at <= self.max_stale_s
+
+    def begin_refresh(self, sig: str) -> bool:
+        """Claim the (single-flight) refresh of a stale entry: True when
+        this caller owns it and must go to the wire — and MUST call
+        :meth:`end_refresh` on every settle path; False when a refresh
+        is already in flight (serve the bounded-stale entry instead)."""
+        with self._lock:
+            if sig in self._refreshing:
+                return False
+            self._refreshing.add(sig)
+            return True
+
+    def end_refresh(self, sig: str) -> None:
+        with self._lock:
+            self._refreshing.discard(sig)
+
+    # -- writes ------------------------------------------------------------
+
+    def put(
+        self, sig: str, keys: np.ndarray, values: np.ndarray, version: int,
+        now: float | None = None, as_of: int | None = None,
+    ) -> CacheEntry | None:
+        """Install freshly pulled rows (replacing any older entry).
+        ``as_of`` is the :attr:`gen` captured when the pull was ISSUED:
+        if any invalidation ran since, the install is skipped (returns
+        None) — the rows may predate a push that already invalidated
+        this key set, and installing them would serve a stale
+        read-your-write. Conservative by design (any invalidation
+        cancels any in-flight install): pushes are rare on the
+        read-mostly tier this cache serves, so a lost install costs one
+        refresh, while a falsely kept one would cost correctness."""
+        now = time.monotonic() if now is None else now
+        keys = np.array(keys, copy=True)
+        values = np.array(values, copy=True)  # own both: callers may reuse
+        ent = CacheEntry(keys, values, int(version), now, now + self.ttl_s)
+        with self._lock:
+            if as_of is not None and as_of != self._gen:
+                wire_counters.inc("serve_cache_put_races")
+                return None
+            old = self._d.pop(sig, None)
+            if old is not None:
+                self._unindex(sig, old)
+            self._d[sig] = ent
+            for k in keys.tolist():
+                self._by_key.setdefault(k, set()).add(sig)
+            while len(self._d) > self.cap:
+                esig, evicted = self._d.popitem(last=False)
+                self._unindex(esig, evicted)
+        return ent
+
+    def revalidated(
+        self, sig: str, version: int, now: float | None = None
+    ) -> None:
+        """A ``not_modified`` reply confirmed the entry's version is
+        still current: re-arm BOTH clocks — the data is as fresh as the
+        round trip that just verified it."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            ent = self._d.get(sig)
+            if ent is None:
+                return
+            ent.version = int(version)
+            ent.filled_at = now
+            ent.expires_at = now + self.ttl_s
+        wire_counters.inc("serve_cache_validates")
+
+    def shed_backoff(self, sig: str, retry_after_s: float) -> None:
+        """The server shed this entry's revalidation: keep serving the
+        (still within-max_stale) entry for ``retry_after_s`` before
+        asking again — but never past the hard ceiling, so a stream of
+        shed replies cannot stretch staleness beyond ``max_stale_s``."""
+        with self._lock:
+            ent = self._d.get(sig)
+            if ent is None:
+                return
+            ent.expires_at = min(
+                time.monotonic() + retry_after_s,
+                ent.filled_at + self.max_stale_s,
+            )
+
+    def invalidate_keys(self, keys: np.ndarray) -> int:
+        """Drop every entry whose key set intersects ``keys`` (exact
+        push invalidation: one inverted-index probe per pushed key);
+        returns how many entries died."""
+        klist = np.asarray(keys).tolist()  # outside the lock: asarray may
+        # sync a device buffer, and the lock must stay nanosecond-scale
+        with self._lock:
+            self._gen += 1  # even when nothing cached matches: an
+            # in-flight pull of exactly these keys has no entry to drop,
+            # and its put must still lose to this invalidation
+            doomed: set[str] = set()
+            for k in klist:
+                sigs = self._by_key.get(k)
+                if sigs:
+                    doomed.update(sigs)
+            for sig in doomed:
+                ent = self._d.pop(sig, None)
+                if ent is not None:
+                    self._unindex(sig, ent)
+        if doomed:
+            wire_counters.inc("serve_cache_invalidations", len(doomed))
+        return len(doomed)
+
+    def _unindex(self, sig: str, ent: CacheEntry) -> None:
+        """Caller holds ``self._lock``."""
+        for k in ent.keys.tolist():
+            sigs = self._by_key.get(k)
+            if sigs is not None:
+                sigs.discard(sig)
+                if not sigs:
+                    del self._by_key[k]

@@ -31,6 +31,16 @@ binary, or JSON carrying ``_bh: 1``); only then does the connection
 switch — so a mixed-version cluster degrades to JSON instead of
 crashing an old peer. Servers simply echo the request's codec.
 
+Serving-plane fields (binary header version 2, ISSUE 7): every shard
+pull reply carries the range's RCU publish version (``ver``); a client
+holding a cached copy pulls conditionally with ``if_newer=<version>``
+and an unchanged shard answers ``not_modified`` — no row payload at
+all. Under overload a server may *shed* a revalidation the client
+flagged ``shed_ok`` (it holds a within-bounds cached fallback) with a
+``retry_after_ms`` hint instead of queueing the encode. ``ver`` /
+``if_newer`` / ``not_modified`` ride fixed binary slots (they're on
+every serving pull); the rare shed fields ride the JSON tail.
+
 Optional wire FEATURES (e.g. the quantized push codec, ``"qwire"``)
 negotiate per connection the same way: a client constructed with
 ``features`` advertises them in a ``_feat`` header list (riding the
@@ -81,7 +91,7 @@ import threading
 import time
 import uuid
 import zlib
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Any, Callable
 
@@ -204,7 +214,12 @@ def _try_compress(view) -> bytes | None:
 # ---------------------------------------------------------------------------
 
 _BMAGIC = 0xB7  # first header byte; JSON always starts with '{' (0x7B)
-_BVERSION = 1
+# version 2 = version 1 + the serving-plane flags2 slots (ver / if_newer
+# / not_modified). Flag evolution is append-only: a v1 frame never sets
+# the new bits, so the v2 decoder reads both layouts; the version byte
+# still hard-rejects anything newer than this build understands.
+_BVERSION = 2
+_BVERSIONS_OK = (1, 2)
 
 # flags1
 _BF_CID = 1
@@ -221,6 +236,15 @@ _BF2_SIG = 2
 _BF2_CODEC = 4
 _BF2_NEED_KEYS = 8
 _BF2_TRANSIENT = 16
+# serving plane (version 2): the RCU publish version a pull reply
+# carries, the client's conditional-pull floor, and the not-modified
+# reply flag — first-class slots because a serving tier pays them on
+# EVERY pull; the rarer shed fields (retry_after_ms, shed) ride the
+# JSON tail like any residual field
+_BF2_NOT_MODIFIED = 32
+_BF2_VER = 64
+_BF2_IF_NEWER = 128
+_BF2_V2_MASK = _BF2_NOT_MODIFIED | _BF2_VER | _BF2_IF_NEWER
 
 _BFIX = struct.Struct("<BBBBBH")  # magic, version, flags1, flags2, cmd_id, narrays
 _I32 = struct.Struct("<i")
@@ -276,6 +300,7 @@ def _encode_bin_header(h: dict[str, Any], metas: list) -> bytes | None:
     flags1 = flags2 = 0
     cmd_id = 0
     cmd_b = cid_b = seq_b = rseq_b = worker_b = sig_b = codec_b = None
+    ver_b = ifn_b = None
     extra: dict[str, Any] | None = None
     est = 14  # {} plus "arrays": []
     for k, v in h.items():
@@ -327,6 +352,21 @@ def _encode_bin_header(h: dict[str, Any], metas: list) -> bytes | None:
             flags2 |= _BF2_CODEC
             codec_b = _B1[v]
             est += 11
+        elif (
+            k == "ver" and type(v) is int and 0 <= v < (1 << 63)
+        ):
+            flags2 |= _BF2_VER
+            ver_b = _I64.pack(v)
+            est += 9 + len(str(v))
+        elif (
+            k == "if_newer" and type(v) is int and 0 <= v < (1 << 63)
+        ):
+            flags2 |= _BF2_IF_NEWER
+            ifn_b = _I64.pack(v)
+            est += 14 + len(str(v))
+        elif k == "not_modified" and v is True:
+            flags2 |= _BF2_NOT_MODIFIED
+            est += 21
         else:
             if extra is None:
                 extra = {}
@@ -346,6 +386,10 @@ def _encode_bin_header(h: dict[str, Any], metas: list) -> bytes | None:
         parts.append(sig_b)
     if codec_b is not None:
         parts.append(codec_b)
+    if ver_b is not None:
+        parts.append(ver_b)
+    if ifn_b is not None:
+        parts.append(ifn_b)
     if len(metas) > 0xFFFF:
         return None
     for name, dt, shape, clen in metas:
@@ -372,8 +416,14 @@ def _encode_bin_header(h: dict[str, Any], metas: list) -> bytes | None:
         parts.append(_U32.pack(len(extra_b)))
         parts.append(extra_b)
         est += len(extra_b)
+    # stamp the LOWEST version whose layout this frame actually uses: a
+    # frame with no v2 slots is byte-identical to a v1 frame, and
+    # stamping it 1 keeps every non-serving frame decodable by v1 peers
+    # (a binary-negotiated mixed cluster must degrade, not livelock —
+    # the _bh ack carries no version, so the stamp is the only guard)
+    ver_byte = _BVERSION if flags2 & _BF2_V2_MASK else 1
     parts[0] = _BFIX.pack(
-        _BMAGIC, _BVERSION, flags1, flags2, cmd_id, len(metas)
+        _BMAGIC, ver_byte, flags1, flags2, cmd_id, len(metas)
     )
     out = b"".join(parts)
     wire_counters.inc_many({
@@ -388,7 +438,7 @@ def _decode_bin_header(raw: memoryview) -> dict[str, Any]:
     would have produced (``arrays`` included)."""
     buf = bytes(raw)
     magic, version, flags1, flags2, cmd_id, narrays = _BFIX.unpack_from(buf, 0)
-    if version != _BVERSION:
+    if version not in _BVERSIONS_OK:
         raise ValueError(f"unsupported binary header version {version}")
     off = _BFIX.size
     h: dict[str, Any] = {}
@@ -431,6 +481,12 @@ def _decode_bin_header(raw: memoryview) -> dict[str, Any]:
     if flags2 & _BF2_CODEC:
         h["codec"] = buf[off]
         off += 1
+    if flags2 & _BF2_VER:
+        h["ver"] = _I64.unpack_from(buf, off)[0]
+        off += 8
+    if flags2 & _BF2_IF_NEWER:
+        h["if_newer"] = _I64.unpack_from(buf, off)[0]
+        off += 8
     if flags1 & _BF_OK_TRUE:
         h["ok"] = True
     elif flags1 & _BF_OK_FALSE:
@@ -441,6 +497,8 @@ def _decode_bin_header(raw: memoryview) -> dict[str, Any]:
         h["need_keys"] = True
     if flags2 & _BF2_TRANSIENT:
         h["_transient"] = True
+    if flags2 & _BF2_NOT_MODIFIED:
+        h["not_modified"] = True
     metas = []
     for _ in range(narrays):
         n = buf[off]
@@ -709,6 +767,11 @@ class RpcServer:
         self.bytes_in = 0
         self.bytes_out = 0
         self.frames_in = 0
+        # live withheld coalesced-reply bytes across ALL connections (the
+        # lo lane pins pull payloads while withheld): the serving plane's
+        # load-shedding signal, distinct from the *_peak gauge telemetry
+        # keeps — shedding needs the current depth, not the high-water
+        self._withheld_now = 0
         self._counter_lock = threading.Lock()  # counters shared by conn threads
         self._accept_thread: threading.Thread | None = None
         self._conns: set[socket.socket] = set()  # live, for stop() to sever
@@ -771,6 +834,8 @@ class RpcServer:
             # reply-coalescing memory gauge: the deepest withheld-bytes
             # point any connection reached (merged cluster-wide as a max)
             wire_counters.observe_max("wire_withheld_bytes_peak", hi_n + lo_n)
+            with self._counter_lock:
+                self._withheld_now += n
 
         def flush_replies() -> None:
             nonlocal hi_bufs, lo_bufs, hi_n, lo_n, hi_frames, lo_frames
@@ -779,6 +844,7 @@ class RpcServer:
             _send_gather(conn, hi_bufs + lo_bufs)  # control lane first
             with self._counter_lock:
                 self.bytes_out += hi_n + lo_n
+                self._withheld_now -= hi_n + lo_n
             hi_bufs, lo_bufs = [], []
             hi_n = lo_n = 0
             hi_frames = lo_frames = 0
@@ -1003,6 +1069,10 @@ class RpcServer:
                 pass
             with self._counter_lock:
                 self._conns.discard(conn)
+                # replies withheld when the conn died were never sent:
+                # release their bytes from the live gauge (zero when the
+                # last flush landed) so shedding can't latch on a corpse
+                self._withheld_now -= hi_n + lo_n
 
     def _dispatch(
         self, cid: str | None, seq: int | None, header: dict[str, Any], arrays: Arrays
@@ -1069,6 +1139,13 @@ class RpcServer:
     def fault_stats(self) -> dict[str, int] | None:
         """Armed plan's fire counts (None when no plan is armed)."""
         return None if self.fault_plan is None else self.fault_plan.stats()
+
+    def withheld_bytes(self) -> int:
+        """Current coalesced-reply bytes withheld across every live
+        connection (the serving plane's shed signal: withheld lo-lane
+        replies pin their pull payload arrays until flushed)."""
+        with self._counter_lock:
+            return self._withheld_now
 
     def stop(self) -> None:
         self._stop.set()
@@ -1739,6 +1816,21 @@ class Coordinator:
         self._monitor = HeartbeatMonitor(heartbeat_timeout_s)
         self._clock: SSPClock | None = None
         self._cv = threading.Condition()
+        # batched beat/progress ingestion (ROADMAP carry-over): these
+        # commands arrive from EVERY node at heartbeat cadence, and
+        # taking _cv (or the monitor lock) once per frame made the
+        # coordinator's hottest traffic its most lock-contended. Frames
+        # now land in this deque (GIL-atomic append, no lock) and ONE
+        # serving thread at a time drains EVERYTHING queued under a
+        # single _cv acquire + a single monitor-lock acquire
+        # (beat_many); concurrent ingest threads skip the drain instead
+        # of queueing on the lock — their frames ride the owner's loop.
+        # Safe because beats and progress are last-writer-wins
+        # telemetry; readers (dead/telemetry/progress_merged/sweep)
+        # drain with wait=True first, so every frame acked before a read
+        # is visible to it.
+        self._ingest: deque[tuple[str, int, Any]] = deque()
+        self._ingest_lock = threading.Lock()  # one drainer at a time
         self._recovered: dict[int, dict[str, Any]] = {}  # worker rank -> info
         self._sweep_stop = threading.Event()
         self._sweep_thread: threading.Thread | None = None
@@ -1774,6 +1866,7 @@ class Coordinator:
         self._sweep_thread.start()
 
     def _sweep_once(self) -> None:
+        self._drain_ingest(wait=True)  # a queued beat must not read dead
         for nid in self._monitor.dead():
             with self._cv:
                 info = dict(self._nodes.get(nid, {}))
@@ -1912,24 +2005,64 @@ class Coordinator:
         return {"ok": True, "requeued": requeued}, {}
 
     def _cmd_progress(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
-        with self._cv:
-            self._progress[int(h["worker"])] = h["record"]
+        self._ingest.append(("progress", int(h["worker"]), h["record"]))
+        self._drain_ingest()
         return {"ok": True}, {}
 
     def _cmd_progress_merged(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        self._drain_ingest(wait=True)  # every acked progress is merged
         with self._cv:
             reports = [dict(r) for r in self._progress.values()]
         return {"ok": True, "merged": merge_progress(reports)}, {}
 
     def _cmd_beat(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
-        self._monitor.beat(int(h["node_id"]), h.get("stats"))
+        self._ingest.append(("beat", int(h["node_id"]), h.get("stats")))
+        self._drain_ingest()
         return {"ok": True}, {}
+
+    def _drain_ingest(self, wait: bool = False) -> None:
+        """Apply every queued beat/progress frame in batches: progress
+        records land under ONE ``_cv`` acquire, beats under ONE monitor
+        lock (``beat_many``) — however many frames the cluster managed
+        to queue since the last drain. Ingest callers pass
+        ``wait=False``: if another thread owns the drain, this frame
+        rides that thread's loop instead of queueing a second acquire.
+        Readers pass ``wait=True`` so they observe every frame whose
+        reply has been (or is being) sent before they read."""
+        if not self._ingest_lock.acquire(blocking=wait):
+            return
+        try:
+            while True:
+                batch: list[tuple[str, int, Any]] = []
+                while True:
+                    try:
+                        batch.append(self._ingest.popleft())
+                    except IndexError:
+                        break
+                if not batch:
+                    return
+                beats = [(k, v) for t, k, v in batch if t == "beat"]
+                prog = [(k, v) for t, k, v in batch if t == "progress"]
+                if prog:
+                    with self._cv:
+                        for worker, record in prog:
+                            self._progress[worker] = record
+                        self._cv.notify_all()
+                if beats:
+                    self._monitor.beat_many(beats)
+                if len(batch) > 1:
+                    wire_counters.inc("coord_ingest_coalesced", len(batch) - 1)
+                # loop: frames appended while we applied are ours too —
+                # their ingest threads saw the held lock and moved on
+        finally:
+            self._ingest_lock.release()
 
     def _cmd_telemetry(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
         """Cluster telemetry (ref: the scheduler's dashboard, reborn):
         every node's last heartbeat piggybacked a counters+histograms
         snapshot; this merges them — plus the coordinator's own process
         — into one cluster view, and returns the per-node detail."""
+        self._drain_ingest(wait=True)  # acked beats are in latest_stats
         with self._cv:
             registry = {int(k): dict(v) for k, v in self._nodes.items()}
         per_node: dict[str, dict[str, Any]] = {}
@@ -1955,6 +2088,7 @@ class Coordinator:
         }, {}
 
     def _cmd_dead(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        self._drain_ingest(wait=True)  # an acked beat must never read dead
         return {"ok": True, "dead": self._monitor.dead(), "alive": self._monitor.alive()}, {}
 
     def _cmd_recovered(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:

@@ -37,12 +37,30 @@ keyed-seq recovery path reuse the already-encoded payload, so chaos
 (drop/disconnect/duplicate) can never double-fold an accumulator.
 ``[wire] quant_pull`` extends the codec to pull replies (read-mostly
 serving traffic; no feedback loop, so it is opt-in).
+
+Serving plane (``[serve]``, ISSUE 7): production traffic is dominated by
+read-mostly pulls from inference, and the OSDI'14 key-cache filter
+generalizes to VALUES for it. Every RCU publish stamps the shard with a
+monotonic per-life snapshot version; pull replies carry it, and a
+serving :class:`ServerHandle` (``serving=True`` + ``[serve] cache``)
+caches the decoded rows per key-set signature — serving them locally
+within ``ttl_ms``, revalidating with ``if_newer=<ver>`` past it (an
+unchanged shard answers ``not_modified`` with zero payload), and
+invalidating its own entries exactly on push. Server-side, concurrent
+and repeated pulls of a HOT key set against one snapshot share a single
+encoded reply (single-flight coalescing), and admission control sheds
+revalidations that advertised a cached fallback (``shed_ok``) once the
+apply queue or the withheld reply bytes cross the ``[serve] shed_*``
+thresholds — bounded staleness for readers instead of unbounded queue
+growth for everyone. The training tier never arms the cache: its
+staleness contract is the SSP clock, not a TTL.
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import os
 import queue as queue_mod
 import threading
 import time
@@ -64,7 +82,7 @@ from parameter_server_tpu.parallel.control import (
     RpcServer,
 )
 from parameter_server_tpu.utils import trace
-from parameter_server_tpu.utils.config import PSConfig, ServerConfig
+from parameter_server_tpu.utils.config import PSConfig, ServeConfig, ServerConfig
 from parameter_server_tpu.utils.heartbeat import HeartbeatReporter, host_stats
 from parameter_server_tpu.utils.keyrange import KeyRange
 from parameter_server_tpu.utils.metrics import (
@@ -129,6 +147,25 @@ class _LruSigs:
             return len(self._d)
 
 
+class _EncodeEntry:
+    """One single-flight encoded pull reply: the first puller of a hot
+    key set against a given snapshot computes the encode; concurrent and
+    later pulls of the same (signature, version, codec) wait on ``event``
+    and reuse the SAME reply header + arrays (``rep is None`` after the
+    event fires means the owner's encode failed — followers encode for
+    themselves). ``nbytes`` is the payload size counted against the
+    cache's byte budget: 0 until filled, and 0 forever if the entry was
+    evicted before its owner filled it."""
+
+    __slots__ = ("event", "rep", "arrays", "nbytes")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.rep: dict[str, Any] | None = None
+        self.arrays: Arrays | None = None
+        self.nbytes = 0
+
+
 class _QueuedPush:
     """One decoded push waiting in the apply queue: keys + decoded grad,
     its durable dedup identity, the caller's trace context (so the apply
@@ -187,13 +224,49 @@ class ShardServer:
         advertise_host: str = "",
         fault_plan: FaultPlan | None = None,
         server_cfg: ServerConfig | None = None,
+        serve_cfg: ServeConfig | None = None,
     ):
         import jax.numpy as jnp
 
         scfg = server_cfg or ServerConfig()
+        svcfg = serve_cfg or ServeConfig()
         self.updater = updater
         self.range = key_range
-        self.state = updater.init(key_range.size, vdim)
+        # versioned RCU publish: (state dict, version) swap as ONE tuple,
+        # so a lock-free reader can never see rows stamped with a version
+        # they don't belong to. The version is an opaque snapshot id —
+        # monotonic within this server life, namespaced by a per-life
+        # nonce in the high bits so a cached version from a PREVIOUS life
+        # (whose tail pushes a checkpoint restart may have rolled back)
+        # can never falsely validate against this one. 23 nonce bits +
+        # 40 counter bits stays under 2^63, so ver / if_newer always fit
+        # the binary header's fixed unsigned slots (an unmasked nonce
+        # overflowed them half the time, silently demoting the serving
+        # fields to the JSON tail for that server life).
+        self._ver_base = (
+            int.from_bytes(os.urandom(3), "big") & ((1 << 23) - 1)
+        ) << 40
+        self._pub: tuple[dict[str, Any], int] = (
+            updater.init(key_range.size, vdim), self._ver_base + 1,
+        )
+        self._serve_cfg = svcfg
+        # single-flight encoded-pull cache: (sig, version, codec) -> entry
+        self._enc_lock = threading.Lock()
+        self._enc_cache: OrderedDict[tuple, _EncodeEntry] = OrderedDict()
+        self._enc_cap = max(0, int(svcfg.encode_cache_entries))
+        self._enc_bytes = 0  # filled entries' payload bytes (LRU-bounded)
+        self._enc_bytes_max = max(0, int(svcfg.encode_cache_mb)) << 20
+        # hot-key detection: pull counts per key-set signature (advisory
+        # — a lost increment under a race only delays hotness by a pull)
+        self._hot_counts = _LruSigs(cap=4096)
+        # host weights snapshot: (version, full weights table as numpy),
+        # materialized lazily on the first HOT pull of a snapshot and
+        # shared by every encode at that version — a hot pull is then a
+        # numpy fancy-index (~us) instead of an eager jax gather +
+        # weights dispatch (~ms). Swapped as one tuple (atomic read);
+        # racing materializations of a fresh version duplicate bounded
+        # work instead of serializing behind a lock.
+        self._host_w: tuple[int, np.ndarray] | None = None
         self._jnp = jnp
         self._key_cache = _LruSigs()  # (worker, sig) -> key array
         self._lock = threading.Lock()
@@ -228,6 +301,11 @@ class ShardServer:
         self.counters = {
             "pulls": 0, "pushes": 0, "cache_hits": 0, "need_keys": 0,
             "push_replays": 0, "apply_batches": 0, "push_coalesced": 0,
+            # serving plane (ISSUE 7): conditional pulls answered without
+            # a payload, pulls shed under overload, real row encodes, and
+            # encodes shared across pulls by the single-flight cache
+            "not_modified": 0, "shed": 0, "pull_encodes": 0,
+            "encode_reuse": 0,
         }
         if host in ("0.0.0.0", "::", "") and not advertise_host:
             raise ValueError(
@@ -277,6 +355,104 @@ class ShardServer:
     def _bump(self, name: str) -> None:
         with self._ctr_lock:
             self.counters[name] += 1
+
+    # -- versioned RCU state ----------------------------------------------
+
+    @property
+    def state(self) -> dict[str, Any]:
+        """The published state table (RCU: immutable after publish)."""
+        return self._pub[0]
+
+    @state.setter
+    def state(self, new_state: dict[str, Any]) -> None:
+        """Publish a new state table AND bump the snapshot version in one
+        reference swap — every writer (batched apply, serial push,
+        checkpoint load) goes through here, so a pull reply's ``ver``
+        always identifies exactly the table its rows came from."""
+        self._pub = (new_state, self._pub[1] + 1)
+
+    @property
+    def version(self) -> int:
+        """Current published snapshot version (opaque; see __init__)."""
+        return self._pub[1]
+
+    # -- serving plane: overload signal + single-flight encode cache ------
+
+    def overloaded(self) -> bool:
+        """Admission-control signal (``[serve] shed_*``): the apply queue
+        is backing up or this server's withheld coalesced replies are
+        pinning too many bytes — time to shed cache-backed pulls."""
+        svcfg = self._serve_cfg
+        if (
+            svcfg.shed_queue_depth > 0
+            and self._apply_q is not None
+            and self._apply_q.qsize() >= svcfg.shed_queue_depth
+        ):
+            return True
+        mb = svcfg.shed_withheld_mb
+        return mb > 0 and self.server.withheld_bytes() >= (mb << 20)
+
+    def _note_pull(self, sig: str) -> bool:
+        """Count one pull of this key-set signature; True once the sig
+        is HOT (its encoded reply is worth caching). The threshold keeps
+        one-off training sweeps out of the encode cache."""
+        c = (self._hot_counts.get(sig) or 0) + 1
+        self._hot_counts.put(sig, c)
+        if c == self._serve_cfg.hot_min_pulls:
+            wire_counters.inc("serve_hot_keys")
+        return c >= self._serve_cfg.hot_min_pulls
+
+    def _enc_claim(self, ck: tuple) -> tuple[_EncodeEntry, bool]:
+        """(entry, owner): owner=True means this pull computes the
+        encode; False means another pull (possibly already finished)
+        owns it and the entry's event/result are to be shared."""
+        with self._enc_lock:
+            ent = self._enc_cache.get(ck)
+            if ent is not None:
+                self._enc_cache.move_to_end(ck)
+                return ent, False
+            ent = self._enc_cache[ck] = _EncodeEntry()
+            self._enc_evict_over_budget()
+            return ent, True
+
+    def _enc_evict_over_budget(self) -> None:
+        """LRU-evict past the entry AND byte budgets (caller holds
+        ``_enc_lock``). Each filled entry pins its reply payload, so the
+        byte bound — not just the entry count — is what stops a server
+        with multi-MB pulls pinning entries x payload of memory.
+        Unfilled entries count 0; an owner filling an already-evicted
+        entry notices and skips the byte accounting."""
+        while self._enc_cache and (
+            len(self._enc_cache) > self._enc_cap
+            or self._enc_bytes > self._enc_bytes_max
+        ):
+            _, old = self._enc_cache.popitem(last=False)
+            self._enc_bytes -= old.nbytes
+
+    def _enc_fill(
+        self, ck: tuple, ent: _EncodeEntry, rep: dict[str, Any],
+        arrays: Arrays,
+    ) -> None:
+        """Publish the owner's finished encode to its followers and
+        count its payload against the byte budget (only while the entry
+        is still cached — a concurrent eviction wins)."""
+        nb = sum(int(a.nbytes) for a in arrays.values())
+        with self._enc_lock:
+            ent.rep, ent.arrays = rep, arrays
+            if self._enc_cache.get(ck) is ent:
+                ent.nbytes = nb
+                self._enc_bytes += nb
+                self._enc_evict_over_budget()
+        ent.event.set()
+
+    def _enc_fail(self, ck: tuple, ent: _EncodeEntry) -> None:
+        """The owner's encode raised: drop the entry and release any
+        followers (they see ``rep is None`` and encode for themselves) —
+        a poisoned entry must never park the reply lane."""
+        with self._enc_lock:
+            if self._enc_cache.get(ck) is ent:
+                del self._enc_cache[ck]
+        ent.event.set()
 
     def start(self) -> "ShardServer":
         self._start_apply_thread()
@@ -625,42 +801,7 @@ class ShardServer:
     def _handle(self, h: dict[str, Any], arrays: Arrays):
         cmd = h["cmd"]
         if cmd == "pull":
-            keys = self._resolve_keys(h, arrays)
-            if keys is None:
-                return {"ok": True, "need_keys": True}, {}
-            # RCU snapshot read: ONE reference capture of the published
-            # state (the apply thread swaps a complete new dict per
-            # batch, never mutates one in place), so this pull sees the
-            # pre- or post-batch table without taking the write lock —
-            # pulls no longer queue behind pushes
-            state = self.state
-            rows = {k: v[keys] for k, v in state.items()}
-            w = np.asarray(self.updater.weights(rows)).reshape(len(keys), -1)
-            self._bump("pulls")
-            qn = int(h.get("quant", 0))
-            if qn:
-                # quantized pull (read-mostly/serving traffic): the rows
-                # ride as per-segment-scale integers at the width the
-                # client asked for. Only quant-negotiated clients send
-                # the field, so an old client can never receive a
-                # payload it can't decode. Round-to-NEAREST, not
-                # stochastic: reads have no error-feedback loop, so
-                # nearest halves the worst-case error and keeps repeated
-                # reads of one unchanged snapshot bit-identical.
-                from parameter_server_tpu.filters.quant import (
-                    SegmentQuantizer,
-                )
-
-                qz = SegmentQuantizer(qn, int(h.get("qseg", 256)))
-                q, qs = qz.encode_nearest(w.ravel())
-                wire_counters.inc(
-                    "wire_quant_bytes_saved",
-                    max(w.nbytes - q.nbytes - qs.nbytes, 0),
-                )
-                return {"ok": True, "codec": qn, "qseg": qz.seg}, {
-                    "q": q, "qs": qs,
-                }
-            return {"ok": True, "zip": h.get("zip", False)}, {"w": w.ravel()}
+            return self._handle_pull(h, arrays)
         if cmd == "push":
             cid = h.get("_cid")
             seq = None if cid is None else str(h.get("_seq"))
@@ -729,6 +870,10 @@ class ShardServer:
             rep = {
                 "ok": True,
                 **self.counters,
+                # current RCU publish version. NOT the key "ver": that
+                # is a binary-header-v2 slot, and stats replies must
+                # stay v1-decodable to old binary peers
+                "state_ver": self.version,
                 "bytes_out": self.server.bytes_out,
                 "bytes_in": self.server.bytes_in,
                 "frames_in": self.server.frames_in,
@@ -738,6 +883,11 @@ class ShardServer:
                 # re-applying (process-wide counter; one server per
                 # process in the spawned tier)
                 "rpc_dedup_hits": wire_counters.get("rpc_dedup_hits"),
+                # serving observability: quantized-pull payload savings
+                # (process-wide, like rpc_dedup_hits above)
+                "wire_quant_bytes_saved": wire_counters.get(
+                    "wire_quant_bytes_saved"
+                ),
             }
             faults = self.server.fault_stats()
             if faults is not None:
@@ -746,6 +896,171 @@ class ShardServer:
         if cmd == "shutdown":
             raise RpcServer.Shutdown
         raise ValueError(f"unknown server command {cmd!r}")
+
+    def _handle_pull(
+        self, h: dict[str, Any], arrays: Arrays
+    ) -> tuple[dict[str, Any], Arrays]:
+        """The read path (ISSUE 7 serving plane). In order:
+
+        1. conditional pull: ``if_newer=<ver>`` against an unchanged
+           snapshot answers ``not_modified`` — no gather, no encode, no
+           payload (the client re-arms its TTL on its cached rows);
+        2. admission control: under overload, a revalidation the client
+           flagged ``shed_ok`` (it holds a within-bounds cached
+           fallback) is shed with a retry-after hint instead of
+           queueing an encode behind the backlog;
+        3. single-flight encode: concurrent/repeated pulls of a HOT key
+           set against the same snapshot share ONE encoded reply — the
+           buffers are reused across the reply lane, not re-gathered
+           per client.
+
+        Replies to VERSION-AWARE pulls (``sv: 1``, sent by serving
+        handles; implied by ``if_newer``) carry ``ver``, the RCU publish
+        version of exactly the table the rows came from. Pulls without
+        the signal get the PR-6 reply shape byte for byte: ``ver`` is a
+        binary-header-v2 slot, and stamping it into every reply would
+        livelock a v1-binary peer in a mixed cluster (the ``sv`` signal
+        itself rides the request's JSON tail, so first-contact requests
+        stay v1-decodable everywhere)."""
+        keys = self._resolve_keys(h, arrays)
+        if keys is None:
+            return {"ok": True, "need_keys": True}, {}
+        # RCU snapshot read: ONE reference capture of the published
+        # (state, version) pair (the apply thread swaps a complete new
+        # tuple per batch, never mutates one in place), so this pull
+        # sees the pre- or post-batch table — never a torn mix, never a
+        # version that disagrees with its rows — without the write lock
+        state, ver = self._pub
+        ifn = h.get("if_newer")
+        sv = bool(h.get("sv")) or ifn is not None
+        if ifn is not None and int(ifn) == ver:
+            # the client's cached rows ARE this snapshot (equality, not
+            # ordering: versions are opaque per-life snapshot ids)
+            self._bump("pulls")
+            self._bump("not_modified")
+            wire_counters.inc("serve_not_modified")
+            return {"ok": True, "not_modified": True, "ver": ver}, {}
+        if ifn is not None and h.get("shed_ok") and self.overloaded():
+            # shed: the client promised a cached fallback within its
+            # staleness ceiling — tell it to keep serving that and come
+            # back, instead of queueing rows behind a saturated engine.
+            # No ``ver``: nothing was validated, so the client must not
+            # re-arm version trust off this reply.
+            self._bump("pulls")
+            self._bump("shed")
+            wire_counters.inc("serve_shed")
+            return {"ok": True, "not_modified": True, "shed": True,
+                    "retry_after_ms": self._serve_cfg.retry_after_ms}, {}
+        qn = int(h.get("quant", 0))
+        ent = None
+        hot = self._enc_cap > 0 and self._note_pull(h["sig"])
+        # sv is part of the cache key: a version-stamped reply cached
+        # for a serving client must never be replayed to a client that
+        # can't decode the v2 header slot (and vice versa)
+        ck = (
+            h["sig"], ver, qn, int(h.get("qseg", 256)),
+            bool(h.get("zip")), sv,
+        )
+        if hot:
+            ent, owner = self._enc_claim(ck)
+            if not owner:
+                # single-flight: another pull of the same keys against
+                # the same snapshot owns the encode — share its buffers
+                # (the wait parks only on a concurrent first encode; a
+                # finished entry's event is already set)
+                if ent.event.wait(timeout=5.0) and ent.rep is not None:
+                    self._bump("pulls")
+                    self._bump("encode_reuse")
+                    wire_counters.inc("serve_encode_reuse")
+                    return ent.rep, ent.arrays
+                ent = None  # owner failed or timed out: encode ourselves
+        try:
+            # snapshot materialization is gated on hot AND a conditional
+            # pull (`if_newer` proves a caching serving client): a
+            # training tier with epoch-repeated key sets and per-step
+            # version churn must never pay a full-table weights()
+            # materialization per step just because its sigs went hot
+            rep, out = self._encode_pull(
+                state, ver, keys, h, qn, hot and ifn is not None,
+                with_ver=sv,
+            )
+        except BaseException:
+            if ent is not None:
+                self._enc_fail(ck, ent)
+            raise
+        self._bump("pulls")
+        self._bump("pull_encodes")
+        if ent is not None:
+            self._enc_fill(ck, ent, rep, out)
+        return rep, out
+
+    def _host_weights(self, state: dict[str, Any], ver: int) -> np.ndarray:
+        """Full weights table for snapshot ``ver``, materialized on the
+        host ONCE per version that receives a hot pull and shared by
+        every encode at that version: a hot pull becomes a numpy
+        fancy-index (~us) instead of an eager jax gather + weights
+        dispatch per request (~ms). Bounded by ``[serve]
+        snapshot_keys_max`` — the caller gates on the range size, so a
+        10^9-key training shard never pays a full-table device->host
+        sync for one read. Benign race: two threads materializing a
+        fresh version duplicate the work; the tuple swap is atomic and
+        last-writer-wins, never torn."""
+        cur = self._host_w
+        if cur is not None and cur[0] == ver:
+            return cur[1]
+        w = np.asarray(self.updater.weights(state)).reshape(
+            self.range.size, -1
+        )
+        self._host_w = (ver, w)
+        return w
+
+    def _encode_pull(
+        self, state: dict[str, Any], ver: int, keys: np.ndarray,
+        h: dict[str, Any], qn: int, snap: bool = False,
+        with_ver: bool = False,
+    ) -> tuple[dict[str, Any], Arrays]:
+        """Gather + encode one pull reply from an RCU snapshot (shared
+        verbatim across clients by the single-flight cache — nothing
+        here may depend on the requesting connection). ``snap`` allows
+        MATERIALIZING the per-version host weights snapshot (hot +
+        revalidation traffic, ranges within ``snapshot_keys_max``); an
+        already-current snapshot serves every pull either way, and
+        everything else keeps the per-row jax path."""
+        cur = self._host_w
+        if cur is not None and cur[0] == ver:
+            # a snapshot for THIS version is already materialized (some
+            # hot pull paid for it): every pull may ride it for free
+            w = cur[1][keys]
+        elif snap and 0 < self.range.size <= self._serve_cfg.snapshot_keys_max:
+            w = self._host_weights(state, ver)[keys]
+        else:
+            rows = {k: v[keys] for k, v in state.items()}
+            w = np.asarray(self.updater.weights(rows)).reshape(len(keys), -1)
+        if qn:
+            # quantized pull (read-mostly/serving traffic): the rows
+            # ride as per-segment-scale integers at the width the
+            # client asked for. Only quant-negotiated clients send
+            # the field, so an old client can never receive a
+            # payload it can't decode. Round-to-NEAREST, not
+            # stochastic: reads have no error-feedback loop, so
+            # nearest halves the worst-case error and keeps repeated
+            # reads of one unchanged snapshot bit-identical.
+            from parameter_server_tpu.filters.quant import SegmentQuantizer
+
+            qz = SegmentQuantizer(qn, int(h.get("qseg", 256)))
+            q, qs = qz.encode_nearest(w.ravel())
+            wire_counters.inc(
+                "wire_quant_bytes_saved",
+                max(w.nbytes - q.nbytes - qs.nbytes, 0),
+            )
+            rep = {"ok": True, "codec": qn, "qseg": qz.seg}
+            if with_ver:  # see _handle_pull: only version-aware clients
+                rep["ver"] = ver
+            return rep, {"q": q, "qs": qs}
+        rep = {"ok": True, "zip": h.get("zip", False)}
+        if with_ver:
+            rep["ver"] = ver
+        return rep, {"w": w.ravel()}
 
     def _decode_grad(self, h: dict[str, Any], arrays: Arrays) -> np.ndarray:
         codec_bytes = int(h.get("codec", 0))
@@ -787,11 +1102,38 @@ class ServerHandle:
         range_size: int = 0,
         resolve_addr=None,  # () -> current address, for server-restart recovery
         reconnect_timeout_s: float | None = None,
+        serving: bool = False,
+        key_cache=None,
     ):
+        """``serving=True`` marks this handle as part of the read-mostly
+        serving tier: with ``[serve] cache`` on, it arms the client-side
+        versioned key cache (filters/keycache.py) — pulls are served
+        locally within the TTL, revalidated by version past it, and
+        invalidated exactly by this handle's own pushes. ``key_cache``
+        lets a serving FRONTEND share one cache across its handles to
+        the same shard (many connections, one process-wide working set —
+        the cache is thread-safe and invalidation stays exact because
+        every handle's pushes invalidate the shared instance). The
+        training tier NEVER passes serving=True: a trainer's staleness
+        contract is the SSP clock, not a TTL (see ``_connect_servers``)."""
         import itertools
 
         self.rank = rank
         self.worker = worker
+        self._kcache = None
+        if serving and cfg.serve.cache:
+            from parameter_server_tpu.filters.keycache import ClientKeyCache
+
+            # `is not None`, NOT `or`: the cache defines __len__, so a
+            # shared instance that happens to be empty is falsy — `or`
+            # would silently hand every handle a private cache
+            self._kcache = key_cache if key_cache is not None else (
+                ClientKeyCache(
+                    cap=cfg.serve.cache_entries,
+                    ttl_s=cfg.serve.ttl_ms / 1e3,
+                    max_stale_s=cfg.serve.max_stale_ms / 1e3,
+                )
+            )
         self._resolve_addr = resolve_addr
         self._reconnect_timeout_s = (
             reconnect_timeout_s
@@ -1096,19 +1438,35 @@ class ServerHandle:
 
     def pull_async(self, local_keys: np.ndarray):
         """Issue a pull without blocking; Future of the float32 rows. Flow
-        events link the issue span to the completion across the window."""
+        events link the issue span to the completion across the window.
+        Serving handles consult the key cache first — a fresh entry
+        resolves the future immediately with zero wire traffic."""
         out_f: Future = Future()
         if len(local_keys) == 0:
             out_f.set_result(np.zeros(0, dtype=np.float32))
             return out_f
-        with trace.span(
-            "ps.pull", cat="ps", rank=self.rank, keys=len(local_keys)
-        ):
-            flow = trace.flow_start("ps.pull.inflight", cat="ps")
-            ctx = trace.wire_context()
-            inner = self._keyed_call_async(
-                "pull", local_keys, {}, **self._pull_fields()
-            )
+        extra: dict[str, Any] = {}
+        sig = ent = None
+        own = False
+        gen = None
+        if self._kcache is not None:
+            vals, extra, sig, ent, own, gen = self._cache_try(local_keys)
+            if vals is not None:
+                out_f.set_result(vals)
+                return out_f
+        try:
+            with trace.span(
+                "ps.pull", cat="ps", rank=self.rank, keys=len(local_keys)
+            ):
+                flow = trace.flow_start("ps.pull.inflight", cat="ps")
+                ctx = trace.wire_context()
+                inner = self._keyed_call_async(
+                    "pull", local_keys, {}, **self._pull_fields(), **extra
+                )
+        except BaseException:
+            if own:
+                self._kcache.end_refresh(sig)
+            raise
 
         def done(f) -> None:
             # nothing may escape (see _keyed_call_async.on_reply): a
@@ -1119,9 +1477,18 @@ class ServerHandle:
                     trace.flow_end(
                         "ps.pull.inflight", cat="ps", flow_id=flow
                     )
-                _, out = f.result()
-                out_f.set_result(self._decode_pull(out))
+                rep, out = f.result()
+                if self._kcache is not None:
+                    out_f.set_result(
+                        self._cache_settle(
+                            rep, out, local_keys, sig, ent, own, gen
+                        )
+                    )
+                else:
+                    out_f.set_result(self._decode_pull(out))
             except BaseException as e:  # noqa: BLE001 — future boundary
+                if own:
+                    self._kcache.end_refresh(sig)  # idempotent release
                 if not out_f.done():
                     out_f.set_exception(e)
 
@@ -1156,6 +1523,14 @@ class ServerHandle:
                         "ps.push.inflight", cat="ps", flow_id=flow
                     )
                 f.result()
+                if self._kcache is not None:
+                    # second, ACK-time invalidation: the server defers
+                    # the ack until the batched apply published, so a
+                    # pull raced between the encode-time invalidation
+                    # and this ack may have re-cached the PRE-apply
+                    # snapshot — drop it now, and read-your-writes holds
+                    # from the moment this future resolves
+                    self._kcache.invalidate_keys(local_keys)
                 done_f.set_result(None)
             except BaseException as e:  # noqa: BLE001 — future boundary
                 if not done_f.done():
@@ -1252,6 +1627,12 @@ class ServerHandle:
         need_keys bounce and the keyed-seq recovery path all reuse the
         returned arrays — so the residual fold below happens exactly once
         however chaotic the wire gets."""
+        if self._kcache is not None:
+            # exact self-invalidation (serving handles): this handle must
+            # never read its own write stale out of its own cache. Done
+            # at encode time — once per logical push — though dropping a
+            # cache entry twice would be harmless anyway.
+            self._kcache.invalidate_keys(local_keys)
         fields: dict[str, Any] = {"codec": 0}
         g = grads.astype(np.float32, copy=False).reshape(len(local_keys), -1)
         if self._quant_bytes and "qwire" in self.client.peer_features:
@@ -1335,16 +1716,118 @@ class ServerHandle:
             return self._quantizer.decode(out["q"], out["qs"])
         return out["w"].astype(np.float32)
 
+    # -- client-side versioned key cache (serving handles only) -----------
+
+    def _cache_try(
+        self, local_keys: np.ndarray
+    ) -> tuple[np.ndarray | None, dict[str, Any], str, Any, bool, int]:
+        """Consult the key cache for one pull: (locally served rows or
+        None, extra wire fields for the revalidation, sig, entry, owns-
+        refresh). A fresh entry short-circuits the wire entirely; a
+        stale one turns the pull into an ``if_newer`` revalidation —
+        claimed single-flight, so while one caller refreshes, concurrent
+        pulls of the same keys serve the bounded-stale rows instead of
+        duplicating the wire refresh. ``shed_ok`` is advertised only
+        while the entry is within the hard staleness ceiling (an
+        overloaded server can never stretch us past it); a caller that
+        got the refresh claim MUST settle it via ``_cache_settle`` or
+        ``end_refresh`` on the error path. The final element is the
+        cache's invalidation generation AT ISSUE: ``_cache_settle``
+        hands it to ``put`` so rows that crossed a concurrent push on
+        the wire are never installed over that push's invalidation."""
+        sig = _sig(local_keys)
+        gen = self._kcache.gen
+        ent = self._kcache.lookup(sig)
+        if ent is None:
+            wire_counters.inc("serve_cache_misses")
+            # sv: ask for the reply's version stamp (rides the JSON
+            # tail; if_newer implies it on the revalidation paths below)
+            return None, {"sv": 1}, sig, None, False, gen
+        if self._kcache.fresh(ent):
+            wire_counters.inc("serve_cache_hits")
+            # a copy, not the cached buffer: callers own their rows and
+            # may scribble on them; the cache must stay pristine
+            return ent.values.copy(), {}, sig, ent, False, gen
+        if not self._kcache.begin_refresh(sig):
+            if self._kcache.can_shed(ent):
+                # another thread's refresh is in flight: serve the
+                # bounded-stale rows rather than duplicate its RTT
+                wire_counters.inc("serve_cache_stale_hits")
+                return ent.values.copy(), {}, sig, ent, False, gen
+            # past the staleness ceiling: correctness wins — do our own
+            # wire pull alongside the in-flight refresh
+            fields: dict[str, Any] = {"if_newer": ent.version}
+            return None, fields, sig, ent, False, gen
+        fields = {"if_newer": ent.version}
+        if self._kcache.can_shed(ent):
+            fields["shed_ok"] = 1
+        return None, fields, sig, ent, True, gen
+
+    def _cache_settle(
+        self, rep: dict[str, Any], out: Arrays,
+        local_keys: np.ndarray, sig: str, ent, own: bool = False,
+        gen: int | None = None,
+    ) -> np.ndarray:
+        """Interpret one pull reply against the cache and return the
+        rows. ``ent`` is the entry reference captured at issue time: a
+        concurrent invalidation doesn't invalidate THIS read (the read
+        was validated against a snapshot that preceded the push), it
+        only stops the entry from being revalidated in place. ``own``
+        releases this pull's single-flight refresh claim."""
+        try:
+            if rep.get("not_modified") and ent is not None:
+                if rep.get("shed"):
+                    # the server shed our revalidation: keep serving the
+                    # cached rows (we only advertised shed_ok while
+                    # inside max_stale) and back off for retry_after
+                    wire_counters.inc("serve_shed_served")
+                    self._kcache.shed_backoff(
+                        sig, float(rep.get("retry_after_ms", 20)) / 1e3
+                    )
+                else:
+                    self._kcache.revalidated(sig, int(rep["ver"]))
+                return ent.values.copy()
+            vals = self._decode_pull(out)
+            ver = rep.get("ver")
+            if ver is not None:
+                # as_of: an invalidation (a concurrent push) since this
+                # pull was issued wins — the install is skipped rather
+                # than resurrect possibly pre-push rows
+                self._kcache.put(
+                    sig, local_keys, vals, int(ver), as_of=gen
+                )
+            return vals
+        finally:
+            if own:
+                self._kcache.end_refresh(sig)
+
     def pull(self, local_keys: np.ndarray) -> np.ndarray:
         if len(local_keys) == 0:
             return np.zeros(0, dtype=np.float32)
-        with trace.span(
-            "ps.pull", cat="ps", rank=self.rank, keys=len(local_keys)
-        ) as sp:
-            _, out = self._keyed_call(
-                "pull", local_keys, {}, **self._pull_fields()
+        extra: dict[str, Any] = {}
+        sig = ent = None
+        own = False
+        gen = None
+        if self._kcache is not None:
+            vals, extra, sig, ent, own, gen = self._cache_try(local_keys)
+            if vals is not None:
+                return vals  # served locally: zero wire traffic
+        try:
+            with trace.span(
+                "ps.pull", cat="ps", rank=self.rank, keys=len(local_keys)
+            ) as sp:
+                rep, out = self._keyed_call(
+                    "pull", local_keys, {}, **self._pull_fields(), **extra
+                )
+                sp.set(bytes=int(sum(a.nbytes for a in out.values())))
+        except BaseException:
+            if own:
+                self._kcache.end_refresh(sig)
+            raise
+        if self._kcache is not None:
+            return self._cache_settle(
+                rep, out, local_keys, sig, ent, own, gen
             )
-            sp.set(bytes=int(sum(a.nbytes for a in out.values())))
         return self._decode_pull(out)
 
     def push(self, local_keys: np.ndarray, grads: np.ndarray) -> None:
@@ -1356,6 +1839,10 @@ class ServerHandle:
             bytes=int(sum(a.nbytes for a in arrays.values())),
         ):
             self._keyed_call("push", local_keys, arrays, **fields)
+        if self._kcache is not None:
+            # ack-time invalidation (see push_async.done): a pull that
+            # raced the deferred apply may have re-cached pre-push rows
+            self._kcache.invalidate_keys(local_keys)
 
     def dump(self) -> tuple[int, np.ndarray]:
         rep, out = self.client.call("dump")
@@ -1483,6 +1970,7 @@ def run_server(
         advertise_host=advertise_host,
         fault_plan=_plan_from_cfg(cfg),
         server_cfg=cfg.server,
+        serve_cfg=cfg.serve,
     )
     if ckpt_dir:
         if srv.load_state(ckpt_dir):
@@ -1524,6 +2012,12 @@ def _connect_servers(
             ServerHandle(
                 fields["addr"], s, worker_rank, cfg,
                 range_size=ranges[s].size, resolve_addr=resolve,
+                # the TRAINING tier: never a serving handle. A trainer's
+                # staleness contract is the SSP clock (bounded delay in
+                # steps), and a TTL cache would stack a second, time-based
+                # staleness on top of it — so training pulls always hit
+                # the wire even when [serve] cache is on for this config.
+                serving=False,
             )
         )
     return handles

@@ -248,6 +248,62 @@ class ServerConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Online serving plane (read-mostly pull traffic): client-side
+    versioned key caching inside ``ServerHandle`` (generalizing the
+    reference's key-cache filter to VALUES), server-side single-flight
+    pull-encode coalescing, and admission control that sheds cache-backed
+    pulls before the apply engine starves. Servers always speak the
+    protocol (versions + not-modified replies cost nothing); the CLIENT
+    cache arms only on handles constructed with ``serving=True`` AND
+    ``cache = true`` — the training tier always bypasses it, because a
+    trainer's staleness is bounded by the SSP clock, not a TTL."""
+
+    # arm the client-side versioned key cache on serving handles
+    cache: bool = False
+    # serve a cached entry locally (no wire traffic at all) while younger
+    # than this; past it the entry revalidates with an if_newer pull
+    # (a not-modified reply re-arms the TTL without moving row bytes)
+    ttl_ms: int = 50
+    # HARD staleness ceiling: a shed revalidation may keep serving the
+    # cached entry only while it is younger than this — past it the
+    # client withholds shed_ok and the server must serve real rows, so
+    # no client ever observes staleness beyond max(ttl, max_stale)
+    max_stale_ms: int = 500
+    # cached key-set entries per handle (LRU; invalidation is exact, so
+    # eviction is a perf knob, never a correctness one)
+    cache_entries: int = 1024
+    # server: a key-set signature becomes HOT (its encoded pull reply is
+    # cached and shared single-flight across clients at one version)
+    # after this many pulls; higher keeps one-off training sweeps out of
+    # the encode cache
+    hot_min_pulls: int = 2
+    # server: encoded-reply cache entries (per (sig, version, codec));
+    # 0 disables pull coalescing entirely
+    encode_cache_entries: int = 256
+    # byte bound on the encoded-reply cache (each entry pins its reply
+    # payload arrays): LRU-evicts past this many MiB, so a training
+    # server with multi-MB pulls can't pin entries x payload of memory
+    # for encodes that version churn will never let it reuse
+    encode_cache_mb: int = 64
+    # server: materialize a full host weights snapshot per version (the
+    # serving read path: hot pulls become numpy fancy-indexing instead
+    # of per-request jax dispatch) only while the shard's key range is
+    # within this bound — a huge training shard must never pay a
+    # full-table device->host sync for one read. 0 disables snapshots.
+    snapshot_keys_max: int = 1 << 22
+    # admission control: shed cache-backed pulls (the client advertised a
+    # fallback via shed_ok) once the apply queue is this deep; 0 off
+    shed_queue_depth: int = 0
+    # ... or once this server's withheld coalesced-reply bytes (the lo
+    # lane pinning pull payloads) cross this many MiB; 0 off
+    shed_withheld_mb: int = 0
+    # rides shed replies: how long the client should serve its cached
+    # entry before revalidating again
+    retry_after_ms: int = 20
+
+
+@dataclass
 class ParallelConfig:
     """Mesh topology: the TPU analog of -num_servers / -num_workers."""
 
@@ -324,6 +380,7 @@ class PSConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     wire: WireConfig = field(default_factory=WireConfig)
     server: ServerConfig = field(default_factory=ServerConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     model_output: str = ""
@@ -368,6 +425,7 @@ _NESTED = {
     "parallel": ParallelConfig,
     "wire": WireConfig,
     "server": ServerConfig,
+    "serve": ServeConfig,
     "fault": FaultConfig,
     "trace": TraceConfig,
 }

@@ -45,11 +45,17 @@ class HeartbeatMonitor:
         self._lock = threading.Lock()
 
     def beat(self, node_id: int, stats: dict | None = None) -> None:
+        self.beat_many([(node_id, stats)])
+
+    def beat_many(self, items: list[tuple[int, dict | None]]) -> None:
+        """Record a whole batch of beats under ONE lock acquisition (the
+        coordinator's batched ingest drain): at cluster scale the beat
+        stream is the monitor's hottest writer, and per-frame acquires
+        made it contend with every dead()/alive() sweep."""
+        now = time.monotonic()
         with self._lock:
-            self._beats[node_id] = {
-                "t": time.monotonic(),
-                "stats": stats or {},
-            }
+            for node_id, stats in items:
+                self._beats[node_id] = {"t": now, "stats": stats or {}}
 
     def alive(self) -> list[int]:
         now = time.monotonic()

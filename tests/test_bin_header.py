@@ -94,6 +94,38 @@ class TestBinHeaderCodec:
         big = {"cmd": "pull", "worker": 1 << 40}  # overflows the i32 slot
         assert _roundtrip(big) == big  # rides the JSON tail instead
 
+    def test_serving_fields_ride_fixed_slots(self):
+        """ISSUE 7: ver / if_newer / not_modified are binary slots
+        (version-2 flags); the rare shed fields ride the JSON tail."""
+        req = {"cmd": "pull", "_seq": 3, "worker": 0, "sig": "s" * 16,
+               "if_newer": (73 << 40) + 12, "shed_ok": 1}
+        assert _roundtrip(req) == req
+        rep = {"ok": True, "_rseq": 3, "ver": (73 << 40) + 13}
+        assert _roundtrip(rep) == rep
+        nm = {"ok": True, "not_modified": True, "ver": 5,
+              "shed": True, "retry_after_ms": 20}
+        assert _roundtrip(nm) == nm
+        # negative versions can't ride the unsigned slot: JSON tail
+        odd = {"cmd": "pull", "if_newer": -3}
+        assert _roundtrip(odd) == odd
+
+    def test_version_byte_is_lowest_layout_used(self):
+        """A frame with no v2 slots is stamped version 1 (byte-identical
+        to the PR-4 layout, so a v1 peer that negotiated binary keeps
+        decoding every non-serving frame — degrade, never livelock);
+        only frames actually carrying ver/if_newer/not_modified stamp 2."""
+        plain = _encode_bin_header(
+            {"cmd": "push", "_cid": "c" * 16, "_seq": "k1", "worker": 0},
+            [],
+        )
+        assert plain[1] == 1
+        serving = _encode_bin_header(
+            {"cmd": "pull", "_seq": 2, "if_newer": 7}, []
+        )
+        assert serving[1] == 2
+        reply = _encode_bin_header({"ok": True, "ver": 9}, [])
+        assert reply[1] == 2
+
     def test_saved_counter_accounts_the_shrink(self):
         wire_counters.reset()
         _encode_bin_header(

@@ -47,6 +47,13 @@ class TestCounterContract:
             "wire_window_grows",
             # ISSUE 5: orphaned deferred replies consumed on conn death
             "rpc_deferred_orphaned",
+            # ISSUE 7 serving plane: the serve_*/cache_* counters ride
+            # the same derived inventory (and therefore the dashboard)
+            "serve_cache_hits", "serve_cache_misses",
+            "serve_cache_stale_hits", "serve_cache_validates",
+            "serve_cache_invalidations", "serve_not_modified",
+            "serve_shed", "serve_shed_served", "serve_encode_reuse",
+            "serve_hot_keys", "coord_ingest_coalesced",
         } <= names
         from parameter_server_tpu.utils.metrics import format_cluster_stats
 
@@ -115,6 +122,13 @@ class TestConfigKeyContract:
 
         self._check_section("server", ServerConfig)
 
+    def test_every_used_serve_key_has_a_default(self):
+        """ISSUE 7: every [serve] key the serving plane reads exists in
+        ServeConfig with a default (derived, like [wire]/[server])."""
+        from parameter_server_tpu.utils.config import ServeConfig
+
+        self._check_section("serve", ServeConfig)
+
     def test_every_section_passes_the_ci_checker(self):
         """Beyond [wire]/[server]: the pslint checker covers EVERY
         config section's reads (data, solver, fault, trace, ...)."""
@@ -174,4 +188,50 @@ class TestBenchCompactServerCell:
         }
         c = bench._compact_contract(full, "f.json")
         assert "error" in c["sub"]["srv"]
+        assert len(json.dumps(c)) < 1500
+
+
+class TestBenchCompactServeCell:
+    def test_serve_cell_rides_the_compact_line(self):
+        """ISSUE 7 acceptance plumbing: the serve cell's QPS speedup,
+        hit rate, coalesce ratio and shed p99 reach the driver-recorded
+        compact line."""
+        import json
+
+        full = {
+            "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+            "platform": "cpu", "raw": {}, "suite_wall_s": 1.0,
+            "sub": {
+                "serve": {
+                    "pull_qps_cached": 12345.6,
+                    "pull_qps_uncached": 321.0,
+                    "qps_speedup_cached": 38.4,
+                    "hit_rate": 0.957,
+                    "coalesce_ratio": 0.12,
+                    "p99_ms_shed": 62.5,
+                    "shed_count": 16,
+                },
+            },
+        }
+        line = json.dumps(bench._compact_contract(full, "f.json"))
+        assert len(line) < 1500
+        c = json.loads(line)
+        assert c["sub"]["serve"] == {
+            "pull_qps_cached": 12345.6,
+            "qps_speedup_cached": 38.4,
+            "hit_rate": 0.957,
+            "coalesce_ratio": 0.12,
+            "p99_ms_shed": 62.5,
+        }
+
+    def test_serve_error_is_marked(self):
+        import json
+
+        full = {
+            "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+            "platform": "cpu", "raw": {}, "suite_wall_s": 1.0,
+            "sub": {"serve": {"error": "boom " * 100}},
+        }
+        c = bench._compact_contract(full, "f.json")
+        assert "error" in c["sub"]["serve"]
         assert len(json.dumps(c)) < 1500

@@ -1,0 +1,644 @@
+"""Online serving plane (ISSUE 7, fast tier-1): the client-side versioned
+key cache (filters/keycache.py), the server's versioned RCU publish +
+conditional pulls + single-flight encode coalescing + load shedding, the
+trainer-tier bypass, cache coherence under wire chaos (staleness never
+exceeds the ttl/version bound, push invalidation exact, exactly-once push
+semantics untouched), and the coordinator's batched beat/progress ingest.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.filters.keycache import ClientKeyCache
+from parameter_server_tpu.kv.updaters import Sgd
+from parameter_server_tpu.parallel.chaos import FaultPlan
+from parameter_server_tpu.parallel.control import (
+    ControlClient,
+    Coordinator,
+)
+from parameter_server_tpu.parallel.multislice import (
+    ServerHandle,
+    ShardServer,
+    _sig,
+)
+from parameter_server_tpu.utils.config import PSConfig, ServeConfig
+from parameter_server_tpu.utils.keyrange import KeyRange
+from parameter_server_tpu.utils.metrics import wire_counters
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    wire_counters.reset()
+    yield
+    wire_counters.reset()
+
+
+def _serve_cfg(**kw) -> ServeConfig:
+    base = dict(cache=True, ttl_ms=10_000, max_stale_ms=60_000,
+                hot_min_pulls=1, encode_cache_entries=64)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _handle(srv, cfg=None, worker=0, serving=True, **kw) -> ServerHandle:
+    if cfg is None:
+        cfg = PSConfig()
+        cfg.serve = _serve_cfg()
+    return ServerHandle(
+        srv.address, 0, worker, cfg, range_size=srv.range.size,
+        serving=serving, **kw,
+    )
+
+
+KEYS = np.arange(1, 9, dtype=np.int64)
+OTHER = np.arange(20, 28, dtype=np.int64)
+
+
+class TestClientKeyCache:
+    def test_ttl_and_revalidation_clocks(self):
+        kc = ClientKeyCache(cap=8, ttl_s=0.05, max_stale_s=0.2)
+        kc.put("s", KEYS, np.ones((8, 1), np.float32), 7, now=100.0)
+        ent = kc.lookup("s")
+        assert kc.fresh(ent, now=100.04)
+        assert not kc.fresh(ent, now=100.06)
+        assert kc.can_shed(ent, now=100.15)
+        assert not kc.can_shed(ent, now=100.25)
+        # a not_modified revalidation re-arms BOTH clocks
+        kc.revalidated("s", 7, now=100.3)
+        assert kc.fresh(ent, now=100.34)
+        assert kc.can_shed(ent, now=100.45)
+        assert wire_counters.get("serve_cache_validates") == 1
+
+    def test_exact_push_invalidation(self):
+        kc = ClientKeyCache(cap=8, ttl_s=10.0, max_stale_s=10.0)
+        kc.put("a", KEYS, np.ones((8, 1), np.float32), 1)
+        kc.put("b", OTHER, np.ones((8, 1), np.float32), 1)
+        # pushed keys overlap entry a only: b must survive (exactness)
+        assert kc.invalidate_keys(np.array([5, 99])) == 1
+        assert kc.lookup("a") is None
+        assert kc.lookup("b") is not None
+        assert wire_counters.get("serve_cache_invalidations") == 1
+        # disjoint pushes invalidate nothing
+        assert kc.invalidate_keys(np.array([1000])) == 0
+
+    def test_lru_eviction_unindexes(self):
+        kc = ClientKeyCache(cap=2, ttl_s=10.0, max_stale_s=10.0)
+        kc.put("a", KEYS, np.zeros((8, 1), np.float32), 1)
+        kc.put("b", OTHER, np.zeros((8, 1), np.float32), 1)
+        kc.put("c", KEYS + 100, np.zeros((8, 1), np.float32), 1)
+        assert kc.lookup("a") is None  # evicted
+        assert len(kc) == 2
+        # the evicted entry's keys left the inverted index: pushing them
+        # is a no-op, not a KeyError or a phantom invalidation
+        assert kc.invalidate_keys(KEYS) == 0
+
+    def test_single_flight_refresh_claim(self):
+        kc = ClientKeyCache(cap=8, ttl_s=0.0, max_stale_s=10.0)
+        assert kc.begin_refresh("s") is True
+        assert kc.begin_refresh("s") is False  # in flight
+        kc.end_refresh("s")
+        assert kc.begin_refresh("s") is True
+        kc.end_refresh("s")
+        kc.end_refresh("s")  # idempotent
+
+    def test_shed_backoff_never_exceeds_max_stale(self):
+        kc = ClientKeyCache(cap=8, ttl_s=0.01, max_stale_s=0.05)
+        kc.put("s", KEYS, np.ones((8, 1), np.float32), 1)
+        ent = kc.lookup("s")
+        kc.shed_backoff("s", retry_after_s=60.0)
+        assert ent.expires_at <= ent.filled_at + 0.05
+
+    def test_put_owns_its_buffers(self):
+        kc = ClientKeyCache(cap=8, ttl_s=10.0, max_stale_s=10.0)
+        vals = np.ones((8, 1), np.float32)
+        kc.put("s", KEYS, vals, 1)
+        vals[:] = 9.0  # caller scribbles after the put
+        assert float(kc.lookup("s").values[0, 0]) == 1.0
+
+    def test_put_loses_to_concurrent_invalidation(self):
+        """A pull reply that crossed a push on the wire must not be
+        installed over that push's invalidation: put(as_of=<gen at
+        issue>) is skipped once ANY invalidation ran — including one
+        whose keys had no cached entry yet (the in-flight first fill)."""
+        kc = ClientKeyCache(cap=8, ttl_s=10.0, max_stale_s=10.0)
+        gen = kc.gen
+        kc.invalidate_keys(KEYS)  # drops nothing, still bumps the gen
+        assert kc.put("s", KEYS, np.ones((8, 1), np.float32), 1,
+                      as_of=gen) is None
+        assert kc.lookup("s") is None
+        assert wire_counters.get("serve_cache_put_races") == 1
+        # a put whose pull saw the current gen installs normally
+        assert kc.put("s", KEYS, np.ones((8, 1), np.float32), 1,
+                      as_of=kc.gen) is not None
+        assert kc.lookup("s") is not None
+
+
+class TestVersionedPull:
+    def test_pull_reply_carries_version_and_push_bumps_it(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256), serve_cfg=_serve_cfg()
+        ).start()
+        h = _handle(srv, serving=False)
+        try:
+            args = dict(arrays={"keys": KEYS.astype(np.uint32)},
+                        worker=0, sig=_sig(KEYS), zip=False)
+            rep, _ = h.client.call("pull", sv=1, **args)
+            v0 = rep["ver"]
+            assert v0 == srv.version
+            h.push(KEYS, np.ones(8, np.float32))
+            rep, _ = h.client.call("pull", sv=1, **args)
+            assert rep["ver"] != v0
+            # a pull WITHOUT the sv signal gets the PR-6 reply shape —
+            # no ver field, so the binary reply stays version-1 and a
+            # v1 peer in a mixed cluster keeps decoding it
+            rep, _ = h.client.call("pull", **args)
+            assert "ver" not in rep
+        finally:
+            h.shutdown()
+            h.close()
+
+    def test_version_fits_the_binary_slot(self):
+        """The per-life nonce is masked so every version (and therefore
+        every if_newer) fits the binary header's unsigned fixed slot —
+        an unmasked nonce overflowed 2^63 half the time, silently
+        demoting the serving fields to the JSON tail for that life."""
+        from parameter_server_tpu.parallel.control import (
+            _encode_bin_header,
+        )
+
+        for _ in range(8):
+            srv = ShardServer(
+                Sgd(eta=1.0), KeyRange(0, 4), serve_cfg=_serve_cfg()
+            )
+            assert 0 < srv.version < (1 << 63)
+            b = _encode_bin_header({"ok": True, "ver": srv.version}, [])
+            assert b is not None and b[1] == 2  # rode the fixed slot
+            srv.server.stop()
+
+    def test_if_newer_equality_semantics(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256), serve_cfg=_serve_cfg()
+        ).start()
+        h = _handle(srv, serving=False)
+        try:
+            args = dict(arrays={"keys": KEYS.astype(np.uint32)},
+                        worker=0, sig=_sig(KEYS), zip=False)
+            rep, _ = h.client.call("pull", sv=1, **args)
+            ver = rep["ver"]
+            # matching version: no payload at all
+            rep, out = h.client.call("pull", if_newer=ver, **args)
+            assert rep.get("not_modified") and not out
+            assert srv.counters["not_modified"] == 1
+            # a version from another server LIFE (equality, not ordering:
+            # a huge stale number must not validate) gets real rows
+            rep, out = h.client.call("pull", if_newer=ver + (1 << 50), **args)
+            assert "not_modified" not in rep and "w" in out
+        finally:
+            h.shutdown()
+            h.close()
+
+
+class TestServingHandle:
+    def test_fresh_hit_serves_locally(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256), serve_cfg=_serve_cfg()
+        ).start()
+        h = _handle(srv)
+        try:
+            w0 = h.pull(KEYS)
+            pulls_before = srv.counters["pulls"]
+            w1 = h.pull(KEYS)  # inside ttl: zero wire traffic
+            np.testing.assert_array_equal(w0, w1)
+            assert srv.counters["pulls"] == pulls_before
+            assert wire_counters.get("serve_cache_hits") == 1
+        finally:
+            h.shutdown()
+            h.close()
+
+    def test_own_push_invalidates_exactly(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256), serve_cfg=_serve_cfg()
+        ).start()
+        h = _handle(srv)
+        try:
+            h.pull(KEYS)
+            h.pull(OTHER)
+            h.push(KEYS, -np.ones(8, np.float32))  # sgd: w -= eta * g
+            # the pushed entry re-reads the wire and sees the new value
+            w = h.pull(KEYS)
+            np.testing.assert_allclose(w, np.ones(8, np.float32))
+            # the disjoint entry is still a local hit (exactness)
+            pulls_before = srv.counters["pulls"]
+            h.pull(OTHER)
+            assert srv.counters["pulls"] == pulls_before
+        finally:
+            h.shutdown()
+            h.close()
+
+    def test_async_push_ack_invalidates_racing_cache_fill(self):
+        """The server defers a push's ack until the batched apply
+        published — a pull issued between the encode-time invalidation
+        and the ack may cache the PRE-apply snapshot. The ACK-time
+        invalidation drops it: once push_async's future resolves, a
+        pull must reflect the write (read-your-writes)."""
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256), serve_cfg=_serve_cfg()
+        ).start()
+        h = _handle(srv)
+        try:
+            h.pull(KEYS)
+            f = h.push_async(KEYS, -np.ones(8, np.float32))
+            h.pull(KEYS)  # may race the deferred apply and re-cache
+            # pre-push rows — allowed: the write isn't acked yet
+            f.result(timeout=30)
+            w = h.pull(KEYS)  # post-ack: MUST see the write
+            np.testing.assert_allclose(w, np.ones(8, np.float32))
+        finally:
+            h.shutdown()
+            h.close()
+
+    def test_ttl_lapse_revalidates_not_modified(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256), serve_cfg=_serve_cfg()
+        ).start()
+        cfg = PSConfig()
+        cfg.serve = _serve_cfg(ttl_ms=5)
+        h = _handle(srv, cfg=cfg)
+        try:
+            w0 = h.pull(KEYS)
+            time.sleep(0.02)
+            w1 = h.pull(KEYS)  # expired -> if_newer -> not_modified
+            np.testing.assert_array_equal(w0, w1)
+            assert srv.counters["not_modified"] == 1
+            assert wire_counters.get("serve_cache_validates") == 1
+            # revalidation re-armed the ttl: next pull is local again
+            pulls_before = srv.counters["pulls"]
+            h.pull(KEYS)
+            assert srv.counters["pulls"] == pulls_before
+        finally:
+            h.shutdown()
+            h.close()
+
+    def test_pull_async_serves_from_cache(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256), serve_cfg=_serve_cfg()
+        ).start()
+        h = _handle(srv)
+        try:
+            w0 = h.pull_async(KEYS).result(timeout=30)
+            pulls_before = srv.counters["pulls"]
+            w1 = h.pull_async(KEYS).result(timeout=30)
+            np.testing.assert_array_equal(w0, w1)
+            assert srv.counters["pulls"] == pulls_before
+        finally:
+            h.shutdown()
+            h.close()
+
+    def test_shared_cache_across_handles(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256), serve_cfg=_serve_cfg()
+        ).start()
+        shared = ClientKeyCache(cap=64, ttl_s=10.0, max_stale_s=60.0)
+        h1 = _handle(srv, worker=0, key_cache=shared)
+        h2 = _handle(srv, worker=1, key_cache=shared)
+        try:
+            # regression: the cache defines __len__, so an EMPTY shared
+            # instance must still be adopted (`is not None`, not `or`)
+            assert h1._kcache is shared and h2._kcache is shared
+            h1.pull(KEYS)
+            pulls_before = srv.counters["pulls"]
+            h2.pull(KEYS)  # h1's fill serves h2 locally
+            assert srv.counters["pulls"] == pulls_before
+        finally:
+            h1.shutdown()
+            h1.close()
+            h2.close()
+
+    def test_training_tier_bypasses_cache(self):
+        """Even with [serve] cache on, a non-serving handle (the training
+        tier: its staleness contract is the SSP clock, not a TTL) never
+        arms the cache — every pull hits the wire."""
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256), serve_cfg=_serve_cfg()
+        ).start()
+        cfg = PSConfig()
+        cfg.serve = _serve_cfg()  # cache=True... but serving=False
+        h = _handle(srv, cfg=cfg, serving=False)
+        try:
+            assert h._kcache is None
+            h.pull(KEYS)
+            h.pull(KEYS)
+            assert srv.counters["pulls"] == 2
+        finally:
+            h.shutdown()
+            h.close()
+
+
+class TestSingleFlightCoalescing:
+    def test_repeated_pulls_share_one_encode(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256),
+            serve_cfg=_serve_cfg(hot_min_pulls=1),
+        ).start()
+        h = _handle(srv, serving=False)
+        try:
+            w0 = h.pull(KEYS)
+            w1 = h.pull(KEYS)  # same snapshot: the cached encode is reused
+            np.testing.assert_array_equal(w0, w1)
+            assert srv.counters["encode_reuse"] == 1
+            assert srv.counters["pull_encodes"] == 1
+        finally:
+            h.shutdown()
+            h.close()
+
+    def test_version_bump_invalidates_encode_cache(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256),
+            serve_cfg=_serve_cfg(hot_min_pulls=1),
+        ).start()
+        h = _handle(srv, serving=False)
+        try:
+            h.pull(KEYS)
+            h.push(KEYS, -np.ones(8, np.float32))
+            w = h.pull(KEYS)  # new version: must re-encode, not replay
+            np.testing.assert_allclose(w, np.ones(8, np.float32))
+            assert srv.counters["pull_encodes"] == 2
+        finally:
+            h.shutdown()
+            h.close()
+
+    def test_concurrent_pulls_coalesce(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 1 << 14),
+            serve_cfg=_serve_cfg(hot_min_pulls=1),
+        ).start()
+        keys = np.arange(1, 2049, dtype=np.int64)
+        handles = [_handle(srv, worker=i, serving=False) for i in range(4)]
+        try:
+            handles[0].pull(keys)  # hot + snapshot warm
+            outs = [None] * 4
+
+            def pull(i):
+                outs[i] = handles[i].pull(keys)
+
+            ths = [threading.Thread(target=pull, args=(i,)) for i in range(4)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            for o in outs:
+                np.testing.assert_array_equal(o, outs[0])
+            # at one version, N pulls of one sig cost ONE encode total
+            assert srv.counters["pull_encodes"] == 1
+            assert srv.counters["encode_reuse"] == 4
+        finally:
+            handles[0].shutdown()
+            for h in handles:
+                h.close()
+
+    def test_encode_cache_byte_budget(self):
+        """Each filled entry pins its reply payload: the cache evicts
+        past the BYTE budget, not just the entry count, so a server
+        with big pulls can't pin entries x payload of memory."""
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 1 << 16),
+            serve_cfg=_serve_cfg(
+                hot_min_pulls=1, encode_cache_entries=64, encode_cache_mb=1,
+            ),
+        ).start()
+        h = _handle(srv, serving=False)
+        try:
+            for i in range(12):  # 12 x 128 KiB of f32 rows = 1.5 MiB
+                keys = np.arange(1 + i, 1 + i + (1 << 15), dtype=np.int64)
+                h.pull(keys)
+            assert srv._enc_bytes <= 1 << 20
+            assert len(srv._enc_cache) < 12  # the byte bound evicted
+        finally:
+            h.shutdown()
+            h.close()
+
+    def test_hot_threshold_keeps_cold_sigs_out(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256),
+            serve_cfg=_serve_cfg(hot_min_pulls=3),
+        ).start()
+        h = _handle(srv, serving=False)
+        try:
+            h.pull(KEYS)
+            h.pull(KEYS)  # below the threshold: no encode cache yet
+            assert srv.counters["encode_reuse"] == 0
+            h.pull(KEYS)  # 3rd: hot — claims the cache entry
+            h.pull(KEYS)  # 4th: reuses it
+            assert srv.counters["encode_reuse"] == 1
+        finally:
+            h.shutdown()
+            h.close()
+
+
+class TestLoadShedding:
+    def _overloaded_pair(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256),
+            serve_cfg=_serve_cfg(ttl_ms=5, max_stale_ms=10_000),
+        ).start()
+        cfg = PSConfig()
+        cfg.serve = _serve_cfg(ttl_ms=5, max_stale_ms=10_000)
+        h = _handle(srv, cfg=cfg)
+        writer = _handle(srv, worker=1, serving=False)
+        return srv, h, writer
+
+    def test_shed_serves_cached_within_bound(self):
+        srv, h, writer = self._overloaded_pair()
+        try:
+            w0 = h.pull(KEYS)
+            writer.push(KEYS, -np.ones(8, np.float32))  # version moves
+            srv.overloaded = lambda: True  # force the admission check
+            time.sleep(0.02)  # ttl lapse -> revalidation with shed_ok
+            w1 = h.pull(KEYS)
+            np.testing.assert_array_equal(w0, w1)  # bounded-stale serve
+            assert srv.counters["shed"] == 1
+            assert wire_counters.get("serve_shed_served") == 1
+            # load drops: the backoff lapses and the next revalidation
+            # fetches the REAL rows
+            srv.overloaded = lambda: False
+            time.sleep(0.05)
+            w2 = h.pull(KEYS)
+            np.testing.assert_allclose(w2, np.ones(8, np.float32))
+        finally:
+            h.shutdown()
+            h.close()
+            writer.close()
+
+    def test_past_max_stale_is_never_shed(self):
+        srv, h, writer = self._overloaded_pair()
+        try:
+            h.pull(KEYS)
+            writer.push(KEYS, -np.ones(8, np.float32))
+            srv.overloaded = lambda: True
+            h._kcache.max_stale_s = 0.0  # hard ceiling already crossed
+            time.sleep(0.02)
+            w = h.pull(KEYS)  # no shed_ok advertised -> real rows
+            np.testing.assert_allclose(w, np.ones(8, np.float32))
+            assert srv.counters["shed"] == 0
+        finally:
+            h.shutdown()
+            h.close()
+            writer.close()
+
+    def test_training_pulls_never_shed(self):
+        """A pull without if_newer (no cached fallback) is never shed,
+        whatever the load — shedding only defers clients that promised
+        they can serve stale."""
+        srv, h, writer = self._overloaded_pair()
+        try:
+            srv.overloaded = lambda: True
+            w = writer.pull(KEYS)  # serving=False: plain pull
+            assert len(w) == 8
+            assert srv.counters["shed"] == 0
+        finally:
+            h.shutdown()
+            h.close()
+            writer.close()
+
+    def test_overloaded_signal_thresholds(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256),
+            serve_cfg=_serve_cfg(shed_queue_depth=0, shed_withheld_mb=0),
+        ).start()
+        try:
+            assert srv.overloaded() is False  # both signals disabled
+            srv._serve_cfg.shed_queue_depth = 1
+            assert srv.overloaded() is False  # queue empty
+            assert srv.server.withheld_bytes() == 0
+        finally:
+            srv.server.stop()
+
+
+class TestServingChaosCoherence:
+    """Cache coherence under drop/disconnect/duplicate with caching ON:
+    staleness never exceeds the ttl/version bound, push invalidation is
+    exact, and exactly-once push semantics are untouched."""
+
+    PLAN = "drop,cmd=pull,every=7;disconnect,cmd=push,every=5;duplicate,every=6"
+
+    def test_read_your_writes_and_exactly_once_under_chaos(self):
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256),
+            fault_plan=FaultPlan.parse(self.PLAN, seed=3),
+            serve_cfg=_serve_cfg(),
+        ).start()
+        cfg = PSConfig()
+        cfg.serve = _serve_cfg()  # ttl 10s: hits are local unless
+        # invalidated — every read below exercises invalidation, not ttl
+        cfg.fault.reconnect_timeout_s = 30.0
+        h = _handle(srv, cfg=cfg)
+        try:
+            n = 12
+            for i in range(n):
+                h.push(KEYS, -np.ones(8, np.float32))
+                # read-your-write: the push invalidated our cache, so
+                # this pull re-reads the wire and must see ALL i+1
+                # applied pushes (exactly-once: duplicates/resends must
+                # not double-apply, drops must not lose)
+                w = h.pull(KEYS)
+                np.testing.assert_allclose(
+                    w, np.full(8, float(i + 1), np.float32),
+                    err_msg=f"after push {i + 1}",
+                )
+            assert srv.counters["pushes"] == n
+            # the chaos actually fired (the plan engaged the machinery)
+            faults = srv.server.fault_stats()
+            assert faults is not None and faults["frames"] > 0
+        finally:
+            h.shutdown()
+            h.close()
+
+    def test_zero_ttl_never_serves_stale_under_chaos(self):
+        """ttl=0 + max_stale=0: every pull revalidates — values returned
+        are NEVER older than the version bound, chaos or not."""
+        srv = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256),
+            fault_plan=FaultPlan.parse("duplicate,every=4", seed=9),
+            serve_cfg=_serve_cfg(),
+        ).start()
+        cfg = PSConfig()
+        cfg.serve = _serve_cfg(ttl_ms=0, max_stale_ms=0)
+        h = _handle(srv, cfg=cfg)
+        writer = _handle(srv, worker=1, serving=False)
+        try:
+            for i in range(8):
+                # ANOTHER writer moves the value (our cache can't see it)
+                writer.push(KEYS, -np.ones(8, np.float32))
+                w = h.pull(KEYS)  # ttl 0: revalidates, version moved ->
+                # real rows, never the stale cached copy
+                np.testing.assert_allclose(
+                    w, np.full(8, float(i + 1), np.float32)
+                )
+        finally:
+            h.shutdown()
+            h.close()
+            writer.close()
+
+
+class TestBatchedIngest:
+    def test_beat_many_records_all_under_one_acquire(self):
+        from parameter_server_tpu.utils.heartbeat import HeartbeatMonitor
+
+        m = HeartbeatMonitor(timeout_s=5.0)
+        m.beat_many([(1, {"a": 1}), (2, None), (3, {"b": 2})])
+        stats = m.latest_stats()
+        assert set(stats) == {1, 2, 3}
+        assert stats[1] == {"a": 1} and stats[2] == {}
+
+    def test_drain_applies_queued_frames_in_batch(self):
+        c = Coordinator()
+        try:
+            # queue frames directly (what concurrent serving threads do
+            # when another thread owns the drain), then drain once
+            c._ingest.append(("beat", 7, {"x": 1}))
+            c._ingest.append(("progress", 0, {"examples": 10}))
+            c._ingest.append(("beat", 8, None))
+            c._drain_ingest(wait=True)
+            assert set(c._monitor.latest_stats()) == {7, 8}
+            assert c._progress[0] == {"examples": 10}
+            assert wire_counters.get("coord_ingest_coalesced") == 2
+        finally:
+            c.stop()
+
+    def test_wire_beats_and_progress_visible_to_readers(self):
+        c = Coordinator()
+        ctl = ControlClient(c.address)
+        try:
+            nid = ctl.register("worker", rank=0)
+            errs: list = []
+
+            def spam(k):
+                try:
+                    cc = ControlClient(c.address)
+                    for i in range(10):
+                        cc.beat(nid, {"k": k, "i": i})
+                        cc.progress(k, {"examples": i})
+                    cc.close()
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ths = [threading.Thread(target=spam, args=(k,)) for k in range(4)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            assert not errs
+            # every acked frame is visible to the (draining) readers
+            merged = ctl.progress_merged()
+            assert merged["examples"] == 4 * 9  # last record per worker
+            assert nid in {int(x) for x in c._monitor.latest_stats()}
+            dead, alive = ctl.dead_nodes()
+            assert nid in alive
+        finally:
+            ctl.shutdown_server()
+            ctl.close()
