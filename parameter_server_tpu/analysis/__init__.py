@@ -16,11 +16,25 @@ invariants PRs 1-4 introduced:
                          served command has a binary cmd id
     trace-hygiene        spans only via `with trace.span(...)` / @traced
     pragma-hygiene       every suppression carries a justification
+    rcu                  published (state, version) snapshots are never
+                         mutated; raw publish-attr access stays under
+                         the apply lock or the snapshot property
+                         (dataflow-backed: analysis/dataflow.py)
+    wireproto            binary-header slot tables encode<->decode in
+                         lockstep with v2 version gating, _CMD_IDS stays
+                         collision-free, feature adverts have both
+                         sides, every queued reply flows through
+                         decorated() (dataflow-backed)
+    stale-pragma         a justified pragma that suppresses nothing is
+                         itself a finding (suppressions can't outlive
+                         the code they excused)
 
 Suppressions: ``# psl: ignore[<checker>]: <why>`` at the flagged line;
-tree policy in pyproject.toml ``[tool.pslint]``. The runtime complement
-(analysis/witness.py, armed with PS_LOCK_WITNESS=1) enforces the
-lock-order discipline on the orders a live process ACTUALLY takes.
+tree policy in pyproject.toml ``[tool.pslint]``. The runtime complements:
+analysis/witness.py (``PS_LOCK_WITNESS=1``) enforces lock order on the
+orders a live process ACTUALLY takes, and analysis/explorer.py
+(``PS_SCHED=<seed>``) forces seeded adversarial interleavings at
+lock/queue/RCU-publish boundaries and replays them from the seed.
 
 Adding a checker: one module exporting ``check_<name>(index)``, one line
 in ``CHECKERS`` below, one positive+negative test in tests/test_pslint.py.
@@ -44,6 +58,7 @@ from parameter_server_tpu.analysis.core import (
     PackageIndex,
     PslintConfig,
     check_pragma_hygiene,
+    check_stale_pragma,
     load_package,
     run_checkers,
 )
@@ -51,9 +66,11 @@ from parameter_server_tpu.analysis.lockgraph import (
     build_lock_graph,
     check_lock_order,
 )
+from parameter_server_tpu.analysis.rcu import check_rcu
 from parameter_server_tpu.analysis.replycache import check_replycache_contract
 from parameter_server_tpu.analysis.settle import check_settle_exactly_once
 from parameter_server_tpu.analysis.tracehygiene import check_trace_hygiene
+from parameter_server_tpu.analysis.wireproto import check_wireproto
 
 __all__ = [
     "CHECKERS",
@@ -79,6 +96,12 @@ CHECKERS: dict[str, Checker] = {
     "replycache-contract": check_replycache_contract,
     "trace-hygiene": check_trace_hygiene,
     "pragma-hygiene": check_pragma_hygiene,
+    # ISSUE 8 (pslint v2): the dataflow-backed pair + the pragma audit
+    "rcu": check_rcu,
+    "wireproto": check_wireproto,
+    # special-cased by run_checkers: audits suppression USAGE, so it
+    # runs off the other enabled checkers' raw findings
+    "stale-pragma": check_stale_pragma,
 }
 
 

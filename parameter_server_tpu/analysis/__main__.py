@@ -1,15 +1,68 @@
 """``python -m parameter_server_tpu.analysis`` — run pslint, exit 1 on
 findings. The same entry backs ``python -m parameter_server_tpu.cli
 lint`` and the tier-1 clean-package test, so CI, the CLI and the tests
-can never disagree about what clean means."""
+can never disagree about what clean means.
+
+CI integration (ISSUE 8): ``--json`` emits machine-readable findings
+(checker, file, line, message, plus ``id`` — the checker name a
+``# psl: ignore[<id>]: <why>`` pragma takes); ``--baseline FILE`` gates
+on *no NEW findings* against a recorded baseline instead of absolute
+cleanliness, so a refactor-heavy PR (direction #1's replication churn)
+can land with pre-existing debt visible but frozen. Baseline entries
+match on (checker, file, message) — deliberately line-insensitive, so
+edits above a finding don't churn the gate — and are counted as a
+multiset, so introducing a SECOND instance of an already-baselined
+finding still fails. ``--update-baseline`` rewrites the file from the
+current findings (the reviewed way to accept or retire debt)."""
 
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+from collections import Counter
+from pathlib import Path
 
 from parameter_server_tpu.analysis import CHECKERS, PACKAGE_ROOT, analyze_package
+from parameter_server_tpu.analysis.core import Finding
+
+
+def finding_json(f: Finding) -> dict:
+    return {
+        "checker": f.checker,
+        "file": f.path,
+        "line": f.line,
+        "message": f.message,
+        # the pragma-able id: # psl: ignore[<id>]: <why> on f.line
+        "id": f.checker,
+    }
+
+
+def _baseline_key(d: dict) -> tuple:
+    return (d.get("checker"), d.get("file"), d.get("message"))
+
+
+def load_baseline(path: Path) -> Counter:
+    data = json.loads(path.read_text())
+    entries = data["findings"] if isinstance(data, dict) else data
+    return Counter(_baseline_key(d) for d in entries)
+
+
+def new_vs_baseline(
+    findings: list[Finding], baseline: Counter
+) -> list[Finding]:
+    """Findings beyond the baseline's multiset (oldest-seen instances of
+    a repeated key are forgiven first — which instance of N identical
+    findings is 'new' is unknowable without line anchoring)."""
+    budget = Counter(baseline)
+    out: list[Finding] = []
+    for f in findings:
+        k = (f.checker, f.path, f.message)
+        if budget[k] > 0:
+            budget[k] -= 1
+        else:
+            out.append(f)
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -27,7 +80,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument(
         "--json", action="store_true", help="machine-readable output"
     )
+    p.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="gate on no NEW findings vs this JSON baseline (missing "
+        "file = empty baseline); combine with --update-baseline to "
+        "(re)record it",
+    )
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="write the current findings to --baseline and exit 0",
+    )
     args = p.parse_args(argv)
+    if args.update_baseline and not args.baseline:
+        p.error("--update-baseline requires --baseline FILE")
     checkers = CHECKERS
     if args.checker:
         unknown = sorted(set(args.checker) - set(CHECKERS))
@@ -35,16 +100,34 @@ def main(argv: list[str] | None = None) -> int:
             p.error(f"unknown checker(s) {unknown}; known: {sorted(CHECKERS)}")
         checkers = {n: CHECKERS[n] for n in args.checker}
     findings = analyze_package(args.root, checkers=checkers)
-    if args.json:
-        print(json.dumps([f.__dict__ for f in findings]))
-    else:
-        for f in findings:
-            print(f.render())
+    if args.baseline and args.update_baseline:
+        Path(args.baseline).write_text(json.dumps(
+            {"findings": [finding_json(f) for f in findings]}, indent=1,
+        ))
         print(
-            f"pslint: {len(findings)} finding(s), "
+            f"pslint: baseline {args.baseline} updated "
+            f"({len(findings)} finding(s))"
+        )
+        return 0
+    gated = findings
+    if args.baseline:
+        bp = Path(args.baseline)
+        baseline = load_baseline(bp) if bp.exists() else Counter()
+        gated = new_vs_baseline(findings, baseline)
+    if args.json:
+        print(json.dumps([finding_json(f) for f in gated]))
+    else:
+        for f in gated:
+            print(f.render())
+        suffix = (
+            f" ({len(gated)} NEW vs baseline {args.baseline})"
+            if args.baseline else ""
+        )
+        print(
+            f"pslint: {len(findings)} finding(s){suffix}, "
             f"{len(checkers)} checker(s) over {args.root}"
         )
-    return 1 if findings else 0
+    return 1 if gated else 0
 
 
 if __name__ == "__main__":

@@ -25,7 +25,11 @@ from __future__ import annotations
 
 import ast
 
-from parameter_server_tpu.analysis.callgraph import CallGraph, OwnerKey
+from parameter_server_tpu.analysis.callgraph import (
+    CallGraph,
+    OwnerKey,
+    shared_callgraph,
+)
 from parameter_server_tpu.analysis.core import (
     Finding,
     HeldLockWalker,
@@ -142,7 +146,7 @@ class _BlockWalker(HeldLockWalker):
 
 
 def check_blocking_under_lock(index: PackageIndex) -> list[Finding]:
-    graph = CallGraph(index)
+    graph = shared_callgraph(index)
     summaries = may_block_summaries(graph)
     out: list[Finding] = []
     for f in index.files:

@@ -23,6 +23,7 @@ precise enough for this package's idioms, simple enough to audit.
 from __future__ import annotations
 
 import ast
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -31,6 +32,21 @@ from parameter_server_tpu.analysis.core import PackageIndex, lock_ctor_name
 #: owner key of a function body: ("m", class_name, method_name) or
 #: ("f", relpath, func_name)
 OwnerKey = tuple[str, str, str]
+
+_shared: "weakref.WeakKeyDictionary[PackageIndex, CallGraph]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def shared_callgraph(index: PackageIndex) -> "CallGraph":
+    """One CallGraph per index, shared by every checker in a run: the
+    tables are build-once read-only, and with three dataflow-backed
+    checkers plus the lock pair all resolving calls, rebuilding per
+    checker would walk the whole package's ASTs five times per lint."""
+    g = _shared.get(index)
+    if g is None:
+        g = _shared[index] = CallGraph(index)
+    return g
 
 
 @dataclass

@@ -96,15 +96,33 @@ class PslintConfig:
 def _parse_pragmas(text: str) -> dict[int, Pragma]:
     """Map suppressed-line -> Pragma. A pragma trailing code suppresses
     its own line; a pragma on a comment-only line suppresses the NEXT
-    line (for statements too long to share a line with their reason)."""
+    line (for statements too long to share a line with their reason).
+
+    Parsed from COMMENT tokens, not raw lines: a pragma-shaped string
+    inside a docstring (this package documents its own grammar) is
+    prose, not a suppression — the line-regex form silently treated it
+    as one, which both confused the stale-pragma audit and could have
+    let a docstring suppress a real finding on its own line."""
+    import io
+    import tokenize
+
     out: dict[int, Pragma] = {}
-    for i, raw in enumerate(text.splitlines(), start=1):
-        m = _PRAGMA_RE.search(raw)
+    lines = text.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PRAGMA_RE.search(tok.string)
         if m is None:
             continue
+        i = tok.start[0]
         checkers = frozenset(
             c.strip() for c in m.group(1).split(",") if c.strip()
         )
+        raw = lines[i - 1] if 0 < i <= len(lines) else ""
         target = i + 1 if raw.lstrip().startswith("#") else i
         out[target] = Pragma(
             line=target,
@@ -192,8 +210,13 @@ def check_pragma_hygiene(index: PackageIndex) -> list[Finding]:
 
 
 def apply_suppressions(
-    index: PackageIndex, findings: list[Finding]
+    index: PackageIndex,
+    findings: list[Finding],
+    used: set[tuple[str, int]] | None = None,
 ) -> list[Finding]:
+    """Drop pragma-suppressed findings. ``used`` (when given) collects
+    the ``(relpath, pragma_line)`` of every pragma that actually
+    suppressed something — the stale-pragma audit's input."""
     out = []
     for fi in findings:
         sf = index.get(fi.path)
@@ -202,8 +225,67 @@ def apply_suppressions(
             if pr is not None and pr.justification and (
                 "*" in pr.checkers or fi.checker in pr.checkers
             ):
+                if used is not None:
+                    used.add((fi.path, pr.pragma_line))
                 continue
         out.append(fi)
+    return out
+
+
+def check_stale_pragma(index: PackageIndex) -> list[Finding]:
+    """Registry placeholder: the audit needs every OTHER enabled
+    checker's raw findings, so :func:`run_checkers` drives it (see
+    ``stale_pragma_findings``). Running it standalone is vacuous."""
+    return []
+
+
+def stale_pragma_findings(
+    index: PackageIndex,
+    used: set[tuple[str, int]],
+    enabled: set[str],
+    full_registry: set[str],
+) -> list[Finding]:
+    """A justified pragma that no longer suppresses any finding is
+    itself a finding: the code it excused was fixed or deleted, and a
+    suppression that outlives its reason is a hole the next real
+    violation walks through unnoticed. Audited conservatively: a pragma
+    is only judged when every checker it names actually ran (``*``
+    pragmas only under the full registry), so ``--checker`` subset runs
+    can never flag a pragma whose checker they skipped. A checker name
+    outside the registry is flagged unconditionally — a typo'd pragma
+    never suppressed anything to begin with."""
+    out: list[Finding] = []
+    for f in index.files:
+        for pr in f.pragmas.values():
+            if not pr.justification or not pr.checkers:
+                continue  # pragma-hygiene's findings, not stale ones
+            if (f.relpath, pr.pragma_line) in used:
+                continue
+            unknown = sorted(
+                c for c in pr.checkers
+                if c != "*" and c not in full_registry
+            )
+            if unknown:
+                out.append(Finding(
+                    "stale-pragma", f.relpath, pr.pragma_line,
+                    f"pragma names unknown checker(s) {unknown} — it "
+                    "has never suppressed anything (typo?); known: "
+                    + ", ".join(sorted(full_registry)),
+                ))
+                continue
+            names = (
+                full_registry if "*" in pr.checkers else set(pr.checkers)
+            )
+            if not names <= enabled:
+                continue  # a named checker didn't run: can't judge
+            out.append(Finding(
+                "stale-pragma", f.relpath, pr.pragma_line,
+                "stale pragma: # psl: ignore["
+                + ",".join(sorted(pr.checkers))
+                + "] suppresses no finding on its line — the code it "
+                "excused is gone; delete the pragma so the suppression "
+                "can't outlive its reason",
+            ))
     return out
 
 
@@ -213,14 +295,42 @@ def run_checkers(
     config: PslintConfig | None = None,
 ) -> list[Finding]:
     """Run every enabled checker and apply pragma suppressions; the
-    returned list is what gates CI (empty == clean)."""
+    returned list is what gates CI (empty == clean). The stale-pragma
+    audit runs last, over the suppression usage this run observed."""
     config = config or PslintConfig()
     findings: list[Finding] = []
+    enabled: set[str] = set()
     for name, fn in checkers.items():
         if name in config.disable:
             continue
+        enabled.add(name)
+        if name == "stale-pragma":
+            continue  # driven below, off the other checkers' output
         findings.extend(fn(index))
-    findings = apply_suppressions(index, findings)
+    used: set[tuple[str, int]] = set()
+    findings = apply_suppressions(index, findings, used)
+    if "stale-pragma" in enabled:
+        from parameter_server_tpu.analysis import CHECKERS
+
+        stale = stale_pragma_findings(
+            index, used, enabled, set(CHECKERS)
+        )
+        # stale findings are suppressible, but ONLY by a pragma naming
+        # stale-pragma EXPLICITLY (a pragma kept deliberately for a
+        # flapping platform-dependent finding says why with its own
+        # justification). A wildcard must not count: an unused
+        # `ignore[*]` would otherwise suppress its own staleness — the
+        # broadest suppression becoming the one the audit can't retire.
+        for fi in stale:
+            sf = index.get(fi.path)
+            pr = sf.pragmas.get(fi.line) if sf is not None else None
+            if (
+                pr is not None
+                and pr.justification
+                and "stale-pragma" in pr.checkers
+            ):
+                continue
+            findings.append(fi)
     findings.sort(key=lambda fi: (fi.path, fi.line, fi.checker))
     return findings
 

@@ -19,7 +19,11 @@ from __future__ import annotations
 import ast
 from dataclasses import dataclass, field
 
-from parameter_server_tpu.analysis.callgraph import CallGraph, OwnerKey
+from parameter_server_tpu.analysis.callgraph import (
+    CallGraph,
+    OwnerKey,
+    shared_callgraph,
+)
 from parameter_server_tpu.analysis.core import (
     Finding,
     HeldLockWalker,
@@ -154,7 +158,7 @@ def _direct_locks(
 def build_lock_graph(
     index: PackageIndex, graph: CallGraph | None = None
 ) -> LockGraph:
-    graph = graph or CallGraph(index)
+    graph = graph or shared_callgraph(index)
     out = LockGraph(sites=graph.all_lock_keys())
     summaries = _direct_locks(graph)
     for f in index.files:

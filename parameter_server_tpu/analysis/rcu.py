@@ -1,0 +1,331 @@
+"""Checker ``rcu``: the published ``(state, version)`` snapshot is
+immutable, and the raw publish attribute is only touched under the lock.
+
+The batched apply engine's whole reader-side contract (ISSUE 4/7) is
+RCU: writers build a NEW state table and publish it with one reference
+swap through the snapshot property, so lock-free readers (pull, dump,
+the encode cache) see the pre- or post-batch table and never a torn
+mix. Nothing enforced that. A single ``state[k] = ...`` on a captured
+snapshot — easy to write in a replication or failover path that
+"just fixes up one row" — silently breaks every concurrent reader AND
+the version stamp (rows from a mutated table no longer match the ``ver``
+they were served under), and no test catches it unless the exact
+interleaving happens.
+
+This checker makes the discipline static, on dataflow facts
+(analysis/dataflow.py) rather than syntax:
+
+- **publish pattern discovery**: a class with a property returning
+  ``self.<attr>[0]`` and a setter swapping ``self.<attr> = (...)`` is an
+  RCU publisher; the property is the *snapshot property*, ``<attr>``
+  the *raw publish attribute* (``ShardServer.state`` / ``_pub``).
+- **snapshot immutability**: any value aliasing a published snapshot —
+  through assignment, tuple unpacking, subscript reads, helper returns —
+  must never be mutated: subscript-store, ``del``, augmented assign,
+  or a mutating method (``.update``/``.pop``/...) on it is a finding,
+  as is passing it to a callee whose summary mutates that parameter.
+- **raw-attribute discipline**: loads of the raw publish attribute
+  outside the publisher's own property methods (and ``__init__``) must
+  happen under a held lock — everyone else goes through the snapshot
+  property, so the one deliberate lock-free tuple capture in the pull
+  path is a pragma-documented exception, not an idiom that spreads.
+  Stores to the raw attribute outside the setter/``__init__`` are
+  flagged unconditionally: every publish must bump the version.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from parameter_server_tpu.analysis.callgraph import CallGraph, shared_callgraph
+from parameter_server_tpu.analysis.core import Finding, PackageIndex
+from parameter_server_tpu.analysis.dataflow import (
+    EMPTY,
+    DataflowAnalysis,
+    FlowPolicy,
+    Tags,
+    is_param_tag,
+)
+
+#: tag carried by the raw publish tuple; element 0 of it is TAG_SNAP
+TAG_PUB = "rcu-pub"
+#: tag carried by the published state table (and rows read out of it)
+TAG_SNAP = "rcu"
+
+
+@dataclass(frozen=True)
+class Publisher:
+    """One discovered RCU-publishing class."""
+
+    cls: str
+    relpath: str
+    raw_attr: str  # e.g. "_pub"
+    snap_prop: str  # property returning <raw_attr>[0], e.g. "state"
+    #: every property method (getter/setter names) allowed to touch the
+    #: raw attribute without a lock
+    prop_methods: frozenset[str]
+
+
+def _self_attr(expr: ast.AST) -> str | None:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def _returns_self_sub(fndef: ast.FunctionDef) -> tuple[str, int] | None:
+    """``return self.<attr>[<int>]`` -> (attr, int)."""
+    for stmt in fndef.body:
+        if isinstance(stmt, ast.Return) and isinstance(
+            stmt.value, ast.Subscript
+        ):
+            attr = _self_attr(stmt.value.value)
+            s = stmt.value.slice
+            if attr and isinstance(s, ast.Constant) and isinstance(
+                s.value, int
+            ):
+                return attr, s.value
+    return None
+
+
+def _is_property(fndef: ast.FunctionDef) -> bool:
+    return any(
+        isinstance(d, ast.Name) and d.id == "property"
+        for d in fndef.decorator_list
+    )
+
+
+def _is_setter(fndef: ast.FunctionDef) -> str | None:
+    """``@<prop>.setter`` -> prop name."""
+    for d in fndef.decorator_list:
+        if isinstance(d, ast.Attribute) and d.attr == "setter" and isinstance(
+            d.value, ast.Name
+        ):
+            return d.value.id
+    return None
+
+
+def discover_publishers(index: PackageIndex) -> list[Publisher]:
+    out: list[Publisher] = []
+    for f in index.files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            # attr -> {prop reading it}, prop -> element index
+            getters: dict[str, list[tuple[str, int]]] = {}
+            setter_attrs: dict[str, set[str]] = {}
+            prop_methods: set[str] = set()
+            for item in node.body:
+                if not isinstance(item, ast.FunctionDef):
+                    continue
+                if _is_property(item):
+                    r = _returns_self_sub(item)
+                    if r is not None:
+                        getters.setdefault(r[0], []).append(
+                            (item.name, r[1])
+                        )
+                        prop_methods.add(item.name)
+                prop = _is_setter(item)
+                if prop is not None:
+                    for sub in ast.walk(item):
+                        if isinstance(sub, ast.Assign) and isinstance(
+                            sub.value, ast.Tuple
+                        ):
+                            for t in sub.targets:
+                                a = _self_attr(t)
+                                if a:
+                                    setter_attrs.setdefault(a, set()).add(
+                                        prop
+                                    )
+                                    prop_methods.add(item.name)
+            for attr, props in getters.items():
+                snap = [p for p, i in props if i == 0]
+                if snap and attr in setter_attrs:
+                    out.append(Publisher(
+                        cls=node.name,
+                        relpath=f.relpath,
+                        raw_attr=attr,
+                        snap_prop=snap[0],
+                        prop_methods=frozenset(prop_methods),
+                    ))
+    return out
+
+
+class _RcuPolicy(FlowPolicy):
+    def __init__(self, pubs: list[Publisher], graph: CallGraph):
+        self._graph = graph
+        self._by_cls = {p.cls: p for p in pubs}
+        self._snap_props = {p.snap_prop for p in pubs}
+        self._raw_attrs = {p.raw_attr for p in pubs}
+        self.findings: list[tuple[int, str, str]] = []  # (line, relpath, msg)
+        self._relpath = ""
+        self._seen: set[tuple[str, int, str]] = set()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _publisher_for(
+        self, expr: ast.Attribute, cls_name: str | None
+    ) -> Publisher | None:
+        """The Publisher whose snapshot property / raw attr ``expr``
+        reads, resolving the receiver like the call graph does: ``self``
+        through the MRO, ``self.<attr>`` through attr_types, module
+        singletons through global_instances."""
+        recv = expr.value
+        g = self._graph
+        cls: str | None = None
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and cls_name is not None:
+                for info in g.mro(cls_name):
+                    if info.name in self._by_cls:
+                        cls = info.name
+                        break
+            elif recv.id in g.global_instances:
+                cls = g.global_instances[recv.id]
+        elif (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and cls_name is not None
+        ):
+            for info in g.mro(cls_name):
+                t = info.attr_types.get(recv.attr)
+                if t is not None:
+                    cls = t
+                    break
+        return self._by_cls.get(cls) if cls else None
+
+    def _add(self, line: int, msg: str) -> None:
+        key = (self._relpath, line, msg)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.findings.append((line, self._relpath, msg))
+
+    # -- FlowPolicy hooks --------------------------------------------------
+
+    def begin_function(
+        self, relpath: str, cls_name: str | None, fn_name: str
+    ) -> None:
+        self._relpath = relpath
+
+    def seed(
+        self, expr: ast.expr, cls_name: str | None, relpath: str
+    ) -> Tags:
+        if not isinstance(expr, ast.Attribute):
+            return EMPTY
+        pub = self._publisher_for(expr, cls_name)
+        if pub is None:
+            return EMPTY
+        if expr.attr == pub.snap_prop:
+            return frozenset({TAG_SNAP})
+        if expr.attr == pub.raw_attr:
+            return frozenset({TAG_PUB})
+        return EMPTY
+
+    def element(self, tags: Tags, index: object) -> Tags:
+        out = set()
+        for t in tags:
+            if t == TAG_PUB:
+                # element 0 of the publish tuple is the state table;
+                # element 1 is the (immutable int) version
+                if index == 0 or index is None or index == "iter":
+                    out.add(TAG_SNAP)
+            elif not is_param_tag(t):
+                out.add(t)
+        return frozenset(out)
+
+    def on_mutation(
+        self, node: ast.AST, kind: str, tags: Tags, held, desc: str
+    ) -> None:
+        if TAG_SNAP not in tags and TAG_PUB not in tags:
+            return
+        via = {
+            "setitem": "subscript-store into",
+            "setattr": "attribute-store into",
+            "del": "del on",
+            "augassign": "augmented assignment on",
+            "call": "mutating method call on",
+            "callee": "passing to a callee that mutates",
+        }.get(kind, kind)
+        self._add(
+            getattr(node, "lineno", 0),
+            f"{via} {desc}: this value aliases a PUBLISHED RCU snapshot "
+            "(immutable after the reference-swap publish) — lock-free "
+            "readers and the version stamp both break; build a new "
+            "table and publish it through the snapshot property",
+        )
+
+    def on_load(
+        self, expr: ast.expr, cls_name: str | None, held, fn_name: str
+    ) -> None:
+        if not isinstance(expr, ast.Attribute):
+            return
+        if expr.attr not in self._raw_attrs:
+            return
+        pub = self._publisher_for(expr, cls_name)
+        if pub is None or expr.attr != pub.raw_attr:
+            return
+        if cls_name == pub.cls and (
+            fn_name in pub.prop_methods or fn_name == "__init__"
+        ):
+            return
+        if held:
+            return  # under a lock: the sanctioned raw access
+        self._add(
+            expr.lineno,
+            f"raw read of RCU publish attribute {pub.cls}.{pub.raw_attr} "
+            "outside the apply lock — go through the snapshot property "
+            f"({pub.snap_prop}) so readers always capture one published "
+            "tuple",
+        )
+
+
+def _check_raw_stores(
+    index: PackageIndex, pubs: list[Publisher], out: list[Finding]
+) -> None:
+    """Stores to the raw publish attribute outside the setter/__init__:
+    a publish that bypasses the property setter skips the version bump,
+    so a cached ``ver`` would keep validating against changed rows."""
+    from parameter_server_tpu.analysis.core import iter_functions
+
+    by_cls = {p.cls: p for p in pubs}
+    for f in index.files:
+        for cls_name, fndef in iter_functions(f.tree):
+            pub = by_cls.get(cls_name or "")
+            if pub is None:
+                continue
+            if fndef.name in pub.prop_methods or fndef.name == "__init__":
+                continue
+            for sub in ast.walk(fndef):
+                targets: list[ast.expr] = []
+                if isinstance(sub, ast.Assign):
+                    targets = sub.targets
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [sub.target]
+                for t in targets:
+                    if _self_attr(t) == pub.raw_attr:
+                        out.append(Finding(
+                            "rcu", f.relpath, sub.lineno,
+                            f"direct store to {pub.cls}.{pub.raw_attr} "
+                            "bypasses the snapshot property setter (no "
+                            "version bump): publish through "
+                            f"self.{pub.snap_prop} = ...",
+                        ))
+
+
+def check_rcu(index: PackageIndex) -> list[Finding]:
+    pubs = discover_publishers(index)
+    if not pubs:
+        return []
+    graph = shared_callgraph(index)
+    policy = _RcuPolicy(pubs, graph)
+    DataflowAnalysis(index, policy, graph).run()
+    out = [
+        Finding("rcu", rel, line, msg)
+        for line, rel, msg in policy.findings
+    ]
+    _check_raw_stores(index, pubs, out)
+    return out

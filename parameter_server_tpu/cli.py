@@ -161,6 +161,15 @@ def _build_parser() -> argparse.ArgumentParser:
         help="run only this checker (repeatable)",
     )
     li.add_argument("--json", action="store_true")
+    li.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="gate on no NEW findings vs this JSON baseline (CI mode: "
+        "pre-existing debt stays visible but frozen)",
+    )
+    li.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite --baseline from the current findings",
+    )
     return p
 
 
@@ -574,6 +583,10 @@ def main(argv: list[str] | None = None) -> int:
             lint_argv += ["--checker", c]
         if args.json:
             lint_argv.append("--json")
+        if args.baseline:
+            lint_argv += ["--baseline", args.baseline]
+        if args.update_baseline:
+            lint_argv.append("--update-baseline")
         return lint_main(lint_argv)
     if args.cmd == "stats":
         # no config file: stats only needs a live coordinator address

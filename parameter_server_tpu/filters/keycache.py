@@ -22,6 +22,15 @@ key), so a client can never read its own write stale. Staleness against
 OTHER writers is bounded by ``ttl_ms`` — and by ``max_stale_ms`` as a
 hard ceiling when the server sheds revalidations under load.
 
+One cache serves a MULTI-SHARD frontend (ISSUE 8, the PR-7 carry-over):
+entries are namespaced by shard ``rank``. Keys on this wire are
+range-RELATIVE, so two shards produce identical signatures (and
+identical key ints) for different rows — a rank-blind shared cache
+would serve shard A's rows for shard B's pull and cross-invalidate on
+push. Handles pass ``(rank, sig)`` composite signatures and their rank
+to ``put``/``invalidate_keys``; the inverted index keys by
+``(rank, key)``.
+
 Thread safety: one lock around the map + inverted index. Nothing
 blocking ever runs under it (lookups, puts and invalidations are dict
 and small-array operations); the wire round trip always happens with
@@ -47,22 +56,28 @@ class CacheEntry:
     ``filled_at``: when the server last CONFIRMED this version, the
     anchor of the hard ``max_stale`` ceiling)."""
 
-    __slots__ = ("keys", "values", "version", "filled_at", "expires_at")
+    __slots__ = (
+        "keys", "values", "version", "filled_at", "expires_at", "rank",
+    )
 
     def __init__(
         self, keys: np.ndarray, values: np.ndarray, version: int,
-        filled_at: float, expires_at: float,
+        filled_at: float, expires_at: float, rank: int = 0,
     ):
         self.keys = keys
         self.values = values
         self.version = version
         self.filled_at = filled_at
         self.expires_at = expires_at
+        self.rank = rank  # shard namespace of the inverted-index rows
 
 
 class ClientKeyCache:
     """LRU of key-set signature -> :class:`CacheEntry` with an exact
-    inverted index (key -> signatures) driving push invalidation."""
+    inverted index ((rank, key) -> signatures) driving push
+    invalidation. ``sig`` is any hashable — a multi-shard frontend's
+    handles pass ``(rank, digest)`` composites so one shared cache never
+    collides range-relative keys across shards."""
 
     def __init__(
         self, cap: int = 1024, ttl_s: float = 0.05, max_stale_s: float = 0.5
@@ -71,14 +86,14 @@ class ClientKeyCache:
         self.ttl_s = float(ttl_s)
         self.max_stale_s = float(max_stale_s)
         self._lock = threading.Lock()
-        self._d: OrderedDict[str, CacheEntry] = OrderedDict()
-        self._by_key: dict[int, set[str]] = {}
+        self._d: OrderedDict = OrderedDict()  # sig -> CacheEntry
+        self._by_key: dict[tuple[int, int], set] = {}  # (rank, key) -> sigs
         # refresh coalescing: signatures with a revalidation in flight.
         # While one caller refreshes a stale entry, concurrent pulls of
         # the same keys serve the (within-max_stale) cached rows instead
         # of issuing duplicate wire refreshes — ONE refresh per stale
         # entry per expiry, however many threads share the cache.
-        self._refreshing: set[str] = set()
+        self._refreshing: set = set()
         # invalidation generation: bumped by EVERY invalidate_keys call
         # (even one that dropped nothing — the racing pull's entry may
         # not be indexed yet). A put whose pull was issued before a
@@ -101,7 +116,7 @@ class ClientKeyCache:
 
     # -- reads -------------------------------------------------------------
 
-    def lookup(self, sig: str) -> CacheEntry | None:
+    def lookup(self, sig) -> CacheEntry | None:
         """The entry for ``sig`` (LRU-touched), or None. The caller
         decides freshness via :meth:`fresh` / :meth:`can_shed` — lookup
         never drops a stale entry, because a stale entry still carries
@@ -124,7 +139,7 @@ class ClientKeyCache:
         now = time.monotonic() if now is None else now
         return now - ent.filled_at <= self.max_stale_s
 
-    def begin_refresh(self, sig: str) -> bool:
+    def begin_refresh(self, sig) -> bool:
         """Claim the (single-flight) refresh of a stale entry: True when
         this caller owns it and must go to the wire — and MUST call
         :meth:`end_refresh` on every settle path; False when a refresh
@@ -135,15 +150,24 @@ class ClientKeyCache:
             self._refreshing.add(sig)
             return True
 
-    def end_refresh(self, sig: str) -> None:
+    def end_refresh(self, sig) -> None:
         with self._lock:
             self._refreshing.discard(sig)
 
     # -- writes ------------------------------------------------------------
 
+    @staticmethod
+    def _sig_rank(sig) -> int | None:
+        """The rank a ``(rank, digest)`` composite signature carries
+        (None for a plain signature)."""
+        if isinstance(sig, tuple) and sig and isinstance(sig[0], int):
+            return sig[0]
+        return None
+
     def put(
-        self, sig: str, keys: np.ndarray, values: np.ndarray, version: int,
+        self, sig, keys: np.ndarray, values: np.ndarray, version: int,
         now: float | None = None, as_of: int | None = None,
+        rank: int | None = None,
     ) -> CacheEntry | None:
         """Install freshly pulled rows (replacing any older entry).
         ``as_of`` is the :attr:`gen` captured when the pull was ISSUED:
@@ -154,10 +178,25 @@ class ClientKeyCache:
         cancels any in-flight install): pushes are rare on the
         read-mostly tier this cache serves, so a lost install costs one
         refresh, while a falsely kept one would cost correctness."""
+        # index namespace: derived from a composite sig, or given
+        # explicitly — and the two must AGREE, or a push's rank-scoped
+        # invalidation would silently miss this entry and serve stale
+        # pre-push rows for up to the ttl/max_stale bound
+        srank = self._sig_rank(sig)
+        if rank is None:
+            rank = srank if srank is not None else 0
+        elif srank is not None and srank != rank:
+            raise ValueError(
+                f"put(sig={sig!r}, rank={rank}): the composite sig "
+                f"carries rank {srank} — entry and inverted index would "
+                "disagree and exact invalidation would break"
+            )
         now = time.monotonic() if now is None else now
         keys = np.array(keys, copy=True)
         values = np.array(values, copy=True)  # own both: callers may reuse
-        ent = CacheEntry(keys, values, int(version), now, now + self.ttl_s)
+        ent = CacheEntry(
+            keys, values, int(version), now, now + self.ttl_s, int(rank)
+        )
         with self._lock:
             if as_of is not None and as_of != self._gen:
                 wire_counters.inc("serve_cache_put_races")
@@ -167,14 +206,14 @@ class ClientKeyCache:
                 self._unindex(sig, old)
             self._d[sig] = ent
             for k in keys.tolist():
-                self._by_key.setdefault(k, set()).add(sig)
+                self._by_key.setdefault((ent.rank, k), set()).add(sig)
             while len(self._d) > self.cap:
                 esig, evicted = self._d.popitem(last=False)
                 self._unindex(esig, evicted)
         return ent
 
     def revalidated(
-        self, sig: str, version: int, now: float | None = None
+        self, sig, version: int, now: float | None = None
     ) -> None:
         """A ``not_modified`` reply confirmed the entry's version is
         still current: re-arm BOTH clocks — the data is as fresh as the
@@ -189,7 +228,7 @@ class ClientKeyCache:
             ent.expires_at = now + self.ttl_s
         wire_counters.inc("serve_cache_validates")
 
-    def shed_backoff(self, sig: str, retry_after_s: float) -> None:
+    def shed_backoff(self, sig, retry_after_s: float) -> None:
         """The server shed this entry's revalidation: keep serving the
         (still within-max_stale) entry for ``retry_after_s`` before
         asking again — but never past the hard ceiling, so a stream of
@@ -203,19 +242,22 @@ class ClientKeyCache:
                 ent.filled_at + self.max_stale_s,
             )
 
-    def invalidate_keys(self, keys: np.ndarray) -> int:
-        """Drop every entry whose key set intersects ``keys`` (exact
-        push invalidation: one inverted-index probe per pushed key);
-        returns how many entries died."""
+    def invalidate_keys(self, keys: np.ndarray, rank: int = 0) -> int:
+        """Drop every entry of shard ``rank`` whose key set intersects
+        ``keys`` (exact push invalidation: one inverted-index probe per
+        pushed key); returns how many entries died. Rank-scoped: keys
+        are range-relative, so shard A's push must never evict shard
+        B's rows that happen to share local key ints."""
         klist = np.asarray(keys).tolist()  # outside the lock: asarray may
         # sync a device buffer, and the lock must stay nanosecond-scale
+        rank = int(rank)
         with self._lock:
             self._gen += 1  # even when nothing cached matches: an
             # in-flight pull of exactly these keys has no entry to drop,
             # and its put must still lose to this invalidation
-            doomed: set[str] = set()
+            doomed: set = set()
             for k in klist:
-                sigs = self._by_key.get(k)
+                sigs = self._by_key.get((rank, k))
                 if sigs:
                     doomed.update(sigs)
             for sig in doomed:
@@ -226,11 +268,11 @@ class ClientKeyCache:
             wire_counters.inc("serve_cache_invalidations", len(doomed))
         return len(doomed)
 
-    def _unindex(self, sig: str, ent: CacheEntry) -> None:
+    def _unindex(self, sig, ent: CacheEntry) -> None:
         """Caller holds ``self._lock``."""
         for k in ent.keys.tolist():
-            sigs = self._by_key.get(k)
+            sigs = self._by_key.get((ent.rank, k))
             if sigs is not None:
                 sigs.discard(sig)
                 if not sigs:
-                    del self._by_key[k]
+                    del self._by_key[(ent.rank, k)]

@@ -23,6 +23,15 @@ if _os.environ.get("PS_LOCK_WITNESS", "") not in ("", "0"):
 
     _witness.maybe_install_from_env()
 
+# seeded interleaving explorer (analysis/explorer.py): PS_SCHED=<seed>
+# perturbs every package lock/queue/RCU-publish boundary from per-site
+# seeded streams — adversarial interleavings on demand, replayable from
+# the seed. Armed after the witness so forced orders are still checked.
+if _os.environ.get("PS_SCHED", "") not in ("", "0"):
+    from parameter_server_tpu.analysis import explorer as _explorer
+
+    _explorer.maybe_install_from_env()
+
 from parameter_server_tpu.parallel import runtime  # noqa: F401
 from parameter_server_tpu.parallel.mesh import make_mesh  # noqa: F401
 from parameter_server_tpu.parallel.runtime import Runtime  # noqa: F401

@@ -930,7 +930,7 @@ class ShardServer:
         # tuple per batch, never mutates one in place), so this pull
         # sees the pre- or post-batch table — never a torn mix, never a
         # version that disagrees with its rows — without the write lock
-        state, ver = self._pub
+        state, ver = self._pub  # psl: ignore[rcu]: THE sanctioned lock-free read — one atomic capture of the whole (state, version) tuple; the state/version properties would be two captures and could pair rows with a foreign version
         ifn = h.get("if_newer")
         sv = bool(h.get("sv")) or ifn is not None
         if ifn is not None and int(ifn) == ver:
@@ -1110,10 +1110,13 @@ class ServerHandle:
         versioned key cache (filters/keycache.py) — pulls are served
         locally within the TTL, revalidated by version past it, and
         invalidated exactly by this handle's own pushes. ``key_cache``
-        lets a serving FRONTEND share one cache across its handles to
-        the same shard (many connections, one process-wide working set —
-        the cache is thread-safe and invalidation stays exact because
-        every handle's pushes invalidate the shared instance). The
+        lets a serving FRONTEND share ONE cache across ALL its handles —
+        same shard or a whole multi-shard cluster (many connections, one
+        process-wide working set): entries and the inverted invalidation
+        index are namespaced by this handle's ``rank``, so two shards'
+        range-relative keys can never collide or cross-invalidate, and
+        invalidation stays exact because every handle's pushes
+        invalidate the shared instance under its own rank. The
         training tier NEVER passes serving=True: a trainer's staleness
         contract is the SSP clock, not a TTL (see ``_connect_servers``)."""
         import itertools
@@ -1530,7 +1533,7 @@ class ServerHandle:
                     # and this ack may have re-cached the PRE-apply
                     # snapshot — drop it now, and read-your-writes holds
                     # from the moment this future resolves
-                    self._kcache.invalidate_keys(local_keys)
+                    self._kcache.invalidate_keys(local_keys, rank=self.rank)
                 done_f.set_result(None)
             except BaseException as e:  # noqa: BLE001 — future boundary
                 if not done_f.done():
@@ -1632,7 +1635,7 @@ class ServerHandle:
             # never read its own write stale out of its own cache. Done
             # at encode time — once per logical push — though dropping a
             # cache entry twice would be harmless anyway.
-            self._kcache.invalidate_keys(local_keys)
+            self._kcache.invalidate_keys(local_keys, rank=self.rank)
         fields: dict[str, Any] = {"codec": 0}
         g = grads.astype(np.float32, copy=False).reshape(len(local_keys), -1)
         if self._quant_bytes and "qwire" in self.client.peer_features:
@@ -1735,7 +1738,10 @@ class ServerHandle:
         cache's invalidation generation AT ISSUE: ``_cache_settle``
         hands it to ``put`` so rows that crossed a concurrent push on
         the wire are never installed over that push's invalidation."""
-        sig = _sig(local_keys)
+        # (rank, digest) composite: keys are range-relative, so a shared
+        # multi-shard frontend cache must namespace entries by shard —
+        # two shards produce the same digest for different rows
+        sig = (self.rank, _sig(local_keys))
         gen = self._kcache.gen
         ent = self._kcache.lookup(sig)
         if ent is None:
@@ -1794,7 +1800,8 @@ class ServerHandle:
                 # pull was issued wins — the install is skipped rather
                 # than resurrect possibly pre-push rows
                 self._kcache.put(
-                    sig, local_keys, vals, int(ver), as_of=gen
+                    sig, local_keys, vals, int(ver), as_of=gen,
+                    rank=self.rank,
                 )
             return vals
         finally:
@@ -1842,7 +1849,7 @@ class ServerHandle:
         if self._kcache is not None:
             # ack-time invalidation (see push_async.done): a pull that
             # raced the deferred apply may have re-cached pre-push rows
-            self._kcache.invalidate_keys(local_keys)
+            self._kcache.invalidate_keys(local_keys, rank=self.rank)
 
     def dump(self) -> tuple[int, np.ndarray]:
         rep, out = self.client.call("dump")

@@ -395,8 +395,11 @@ class TestPackageClean:
         findings = analyze_package()
         assert findings == [], "\n".join(f.render() for f in findings)
 
-    def test_at_least_five_distinct_checkers_active(self):
-        assert len(CHECKERS) >= 5
+    def test_registry_matches_the_documented_inventory(self):
+        # ISSUE 8 acceptance: 11 registered checkers (8 + rcu, wireproto,
+        # stale-pragma); the README inventory table tracks this set
+        assert len(CHECKERS) == 11
+        assert {"rcu", "wireproto", "stale-pragma"} <= set(CHECKERS)
 
     def test_module_entry_exits_zero(self):
         """The acceptance form: ``python -m parameter_server_tpu.analysis``
@@ -663,6 +666,433 @@ class C:
 # ---------------------------------------------------------------------------
 # witness export through launch_local (ISSUE 6 satellite)
 # ---------------------------------------------------------------------------
+
+
+_RCU = """
+import threading
+
+class S:
+    def __init__(self):
+        self._pub = ({}, 1)
+        self._lock = threading.Lock()
+
+    @property
+    def state(self):
+        return self._pub[0]
+
+    @state.setter
+    def state(self, new):
+        self._pub = (new, self._pub[1] + 1)
+
+    def helper(self):
+        return self.state
+
+    def ok_locked_raw(self):
+        with self._lock:
+            st = self._pub[0]
+        return st
+
+    def ok_copy_mutate(self):
+        c = dict(self.state)
+        c["k"] = 1
+
+    def ok_publish(self):
+        self.state = {"k": 2}
+
+    def ok_read_rows(self):
+        st = self.state
+        return {k: v for k, v in st.items()}
+"""
+
+
+class TestRcuChecker:
+    """The dataflow-backed snapshot-immutability checker (ISSUE 8):
+    aliases of the published (state, version) tuple must never be
+    mutated, raw publish-attr traffic stays inside the property/lock."""
+
+    def _rcu(self, extra: str):
+        return _run(_RCU + extra, "rcu")
+
+    def test_clean_base_passes(self):
+        assert self._rcu("") == []
+
+    def test_subscript_store_on_snapshot_fires(self):
+        fs = self._rcu(
+            "    def bad(self):\n"
+            "        snap = self.state\n"
+            "        snap['k'] = 1\n"
+        )
+        assert fs and "PUBLISHED RCU snapshot" in fs[0].message
+
+    def test_mutating_method_fires(self):
+        fs = self._rcu(
+            "    def bad(self):\n"
+            "        self.state.update({'k': 2})\n"
+        )
+        assert len(fs) == 1 and "mutating method" in fs[0].message
+
+    def test_alias_through_helper_return_fires(self):
+        # interprocedural: helper() returns self.state; its caller's
+        # alias is still the published table
+        fs = self._rcu(
+            "    def bad(self):\n"
+            "        s = self.helper()\n"
+            "        del s['k']\n"
+        )
+        assert fs and "del on" in fs[0].message
+
+    def test_alias_through_tuple_unpack_fires(self):
+        fs = self._rcu(
+            "    def bad(self):\n"
+            "        with self._lock:\n"
+            "            st, ver = self._pub\n"
+            "        st.pop('k')\n"
+        )
+        assert len(fs) == 1 and "st.pop" in fs[0].message
+
+    def test_mutating_callee_fires(self):
+        fs = self._rcu(
+            "    def bad(self):\n"
+            "        scrub(self.state)\n"
+            "\n"
+            "def scrub(d):\n"
+            "    d.clear()\n"
+        )
+        assert fs and "callee that mutates" in fs[0].message
+
+    def test_mutating_method_callee_fires(self):
+        # regression: param indices must line up with call.args for
+        # BOUND calls too (self never rides the arg list) — the package
+        # is almost entirely methods, so an off-by-one here silently
+        # blinds the whole interprocedural leg
+        fs = self._rcu(
+            "    def scrub(self, d):\n"
+            "        d.clear()\n"
+            "    def bad(self):\n"
+            "        self.scrub(self.state)\n"
+        )
+        assert fs and "callee that mutates" in fs[0].message
+
+    def test_alias_through_method_identity_return_fires(self):
+        fs = self._rcu(
+            "    def ident(self, d):\n"
+            "        return d\n"
+            "    def bad(self):\n"
+            "        s = self.ident(self.state)\n"
+            "        s['k'] = 1\n"
+        )
+        assert fs and "subscript-store" in fs[0].message
+
+    def test_raw_read_outside_lock_fires(self):
+        fs = self._rcu(
+            "    def bad(self):\n"
+            "        return self._pub[0]\n"
+        )
+        assert fs and "outside the apply lock" in fs[0].message
+
+    def test_raw_store_outside_setter_fires(self):
+        fs = self._rcu(
+            "    def bad(self):\n"
+            "        self._pub = ({}, 99)\n"
+        )
+        assert fs and "bypasses the snapshot property setter" in fs[0].message
+
+    def test_version_int_is_not_tainted(self):
+        # element 1 of the publish tuple is the immutable version int;
+        # arithmetic on it is not a snapshot mutation
+        fs = self._rcu(
+            "    def ok(self):\n"
+            "        with self._lock:\n"
+            "            st, ver = self._pub\n"
+            "        ver += 1\n"
+            "        return ver\n"
+        )
+        assert fs == []
+
+    def test_real_package_discovers_shard_server_and_passes(self):
+        from parameter_server_tpu.analysis.rcu import discover_publishers
+
+        index = load_package()
+        pubs = discover_publishers(index)
+        assert any(
+            p.cls == "ShardServer" and p.raw_attr == "_pub"
+            and p.snap_prop == "state"
+            for p in pubs
+        ), pubs
+        fs = analyze_package(checkers=_only("rcu"))
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+
+_WIRE = '''
+_BF_CID = 1
+_BF2_WORKER = 1
+_BF2_VER = 64
+_BF2_V2_MASK = _BF2_VER
+
+def _encode_bin_header(h, metas):
+    flags1 = flags2 = 0
+    for k, v in h.items():
+        if k == "_cid":
+            flags1 |= _BF_CID
+        elif k == "worker":
+            flags2 |= _BF2_WORKER
+        elif k == "ver":
+            flags2 |= _BF2_VER
+    ver_byte = 2 if flags2 & _BF2_V2_MASK else 1
+    return bytes([ver_byte, flags1, flags2])
+
+def _decode_bin_header(buf):
+    h = {}
+    flags1, flags2 = buf[1], buf[2]
+    if flags1 & _BF_CID:
+        h["_cid"] = "x"
+    if flags2 & _BF2_WORKER:
+        h["worker"] = 0
+    if flags2 & _BF2_VER:
+        h["ver"] = 1
+    return h
+'''
+
+
+class TestWireprotoChecker:
+    def test_clean_codec_passes(self):
+        assert _run(_WIRE, "wireproto") == []
+
+    def test_encoded_but_not_decoded_fires(self):
+        bad = _WIRE.replace(
+            '    if flags2 & _BF2_VER:\n        h["ver"] = 1\n', ""
+        )
+        fs = _run(bad, "wireproto")
+        assert fs and "encoded but never decoded" in fs[0].message
+
+    def test_flag_pairing_mismatch_fires(self):
+        bad = _WIRE.replace(
+            'if flags1 & _BF_CID:\n        h["_cid"] = "x"',
+            'if flags2 & _BF2_WORKER:\n        h["_cid"] = "x"',
+        )
+        fs = _run(bad, "wireproto")
+        assert fs and "different layouts" in fs[0].message
+
+    def test_ungated_v2_flag_fires(self):
+        bad = _WIRE.replace(
+            "_BF2_V2_MASK = _BF2_VER",
+            "_BF2_IF_NEWER = 128\n_BF2_V2_MASK = _BF2_VER",
+        )
+        fs = _run(bad, "wireproto")
+        assert fs and "missing from the version mask" in fs[0].message
+
+    def test_v1_flag_in_mask_fires(self):
+        bad = _WIRE.replace(
+            "_BF2_V2_MASK = _BF2_VER",
+            "_BF2_V2_MASK = _BF2_VER | _BF2_WORKER",
+        )
+        fs = _run(bad, "wireproto")
+        assert fs and any("v1 flag" in f.message for f in fs)
+
+    def test_duplicate_cmd_name_fires(self):
+        src = (
+            '_CMD_IDS = {c: i + 1 for i, c in enumerate('
+            '("push", "pull", "push"))}\n'
+        )
+        fs = _run(src, "wireproto")
+        assert fs and "shifts every later compact id" in fs[0].message
+
+    def test_duplicate_literal_id_fires(self):
+        fs = _run('_CMD_IDS = {"push": 1, "pull": 1}\n', "wireproto")
+        assert fs and "decode interchangeably" in fs[0].message
+
+    def test_dead_feature_both_directions(self):
+        src = """
+class S:
+    def __init__(self):
+        self.server = RpcServer(self._h, features=frozenset({"qwire"}))
+
+class C:
+    def __init__(self):
+        self.client = RpcClient("a", features=frozenset({"zwire"}))
+"""
+        fs = _run(src, "wireproto")
+        msgs = " | ".join(f.message for f in fs)
+        assert "no RpcClient construction site advertises" in msgs
+        assert "no RpcServer construction site acks" in msgs
+
+    def test_matched_features_pass(self):
+        src = """
+class S:
+    def __init__(self):
+        self.server = RpcServer(self._h, features=frozenset({"qwire"}))
+
+class C:
+    def __init__(self):
+        self.client = RpcClient("a", features=frozenset({"qwire"}))
+"""
+        assert _run(src, "wireproto") == []
+
+    def test_undecorated_reply_fires_and_flow_through_variable_passes(self):
+        src = """
+def serve(conn):
+    def queue_reply(rep, arrays):
+        pass
+
+    def decorated(rep, seq):
+        return dict(rep)
+
+    rep = {"ok": True}
+    queue_reply(decorated(rep, 1), None)
+    d = decorated(rep, 2)
+    queue_reply(d, None)
+"""
+        assert _run(src, "wireproto") == []
+        fs = _run(src + "    queue_reply(rep, None)\n", "wireproto")
+        assert len(fs) == 1 and "decorated()" in fs[0].message
+
+    def test_real_codec_tables_nonvacuous_and_paired(self):
+        """The derived tables actually see the real codec: every
+        serving-plane v2 slot is paired and gated (a derivation
+        regression that returns empty tables would pass everything)."""
+        import ast as ast_mod
+
+        from parameter_server_tpu.analysis.wireproto import (
+            _mask_members,
+            decode_table,
+            encode_table,
+        )
+
+        index = load_package()
+        f = index.get("parallel/control.py")
+        enc = dec = None
+        for node in ast_mod.walk(f.tree):
+            if isinstance(node, ast_mod.FunctionDef):
+                if node.name == "_encode_bin_header":
+                    enc = node
+                elif node.name == "_decode_bin_header":
+                    dec = node
+        et, dt = encode_table(enc), decode_table(dec)
+        for field in ("ver", "if_newer", "not_modified", "_cid", "sig"):
+            assert field in et and et[field] == dt[field], field
+        members, _ = _mask_members(f.tree)
+        assert members == {"_BF2_VER", "_BF2_IF_NEWER", "_BF2_NOT_MODIFIED"}
+        fs = analyze_package(checkers=_only("wireproto"))
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+
+class TestStalePragma:
+    _LIVE = (
+        "import threading\nimport time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def m(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1)  # psl: ignore[blocking-under-lock]: deliberate\n"
+    )
+    _DEAD = _LIVE.replace("            time.sleep(1)  ", "            pass  ")
+
+    def test_live_pragma_is_not_stale(self):
+        assert analyze_sources({"s.py": self._LIVE}) == []
+
+    def test_pragma_outliving_its_violation_fires(self):
+        fs = analyze_sources({"s.py": self._DEAD})
+        assert len(fs) == 1 and fs[0].checker == "stale-pragma"
+        assert "suppresses no finding" in fs[0].message
+
+    def test_unknown_checker_name_fires(self):
+        src = self._LIVE.replace(
+            "ignore[blocking-under-lock]", "ignore[blocking-underlock]"
+        )
+        fs = analyze_sources({"s.py": src})
+        assert {f.checker for f in fs} == {
+            "blocking-under-lock", "stale-pragma",
+        }
+        assert any("unknown checker" in f.message for f in fs)
+
+    def test_stale_wildcard_pragma_cannot_suppress_itself(self):
+        # regression: an unused `ignore[*]` must not swallow its own
+        # stale-pragma finding — the broadest suppression is exactly
+        # the one the audit most needs to retire
+        src = self._DEAD.replace(
+            "ignore[blocking-under-lock]", "ignore[*]"
+        )
+        fs = analyze_sources({"s.py": src})
+        assert len(fs) == 1 and fs[0].checker == "stale-pragma"
+
+    def test_explicit_stale_pragma_suppression_is_honored(self):
+        src = self._DEAD.replace(
+            "ignore[blocking-under-lock]",
+            "ignore[blocking-under-lock, stale-pragma]",
+        )
+        assert analyze_sources({"s.py": src}) == []
+
+    def test_subset_run_never_judges_a_skipped_checker(self):
+        # the pragma names blocking-under-lock; a run that skipped that
+        # checker cannot know whether it still suppresses anything
+        fs = analyze_sources(
+            {"s.py": self._DEAD},
+            checkers={
+                "stale-pragma": CHECKERS["stale-pragma"],
+                "trace-hygiene": CHECKERS["trace-hygiene"],
+            },
+        )
+        assert fs == []
+
+    def test_docstring_grammar_example_is_prose_not_pragma(self):
+        # regression for the tokenizer fix: pragma-shaped text inside a
+        # docstring must neither suppress nor be audited
+        src = (
+            '"""Docs: use # psl: ignore[blocking-under-lock]: why."""\n'
+            "x = 1\n"
+        )
+        assert analyze_sources({"s.py": src}) == []
+
+
+class TestBaselineMode:
+    _VIOLATION = (
+        "import threading\nimport time\n"
+        "_lk = threading.Lock()\n"
+        "def m():\n"
+        "    with _lk:\n"
+        "        time.sleep(1)\n"
+    )
+
+    def _main(self, argv):
+        from parameter_server_tpu.analysis.__main__ import main
+
+        return main(argv)
+
+    def test_baseline_freezes_old_findings_and_gates_new(self, tmp_path, capsys):
+        import json as json_mod
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "a.py").write_text(self._VIOLATION)
+        base = tmp_path / "base.json"
+        # absolute gate fails; recording the baseline succeeds
+        assert self._main(["--root", str(pkg)]) == 1
+        assert self._main(
+            ["--root", str(pkg), "--baseline", str(base),
+             "--update-baseline"]
+        ) == 0
+        # frozen: same findings now pass the gate
+        assert self._main(["--root", str(pkg), "--baseline", str(base)]) == 0
+        # a NEW finding fails again
+        (pkg / "b.py").write_text(self._VIOLATION.replace("_lk", "_lk2"))
+        capsys.readouterr()
+        assert self._main(
+            ["--root", str(pkg), "--baseline", str(base), "--json"]
+        ) == 1
+        out = json_mod.loads(capsys.readouterr().out)
+        assert len(out) == 1 and out[0]["file"] == "b.py"
+        assert out[0]["id"] == out[0]["checker"] == "blocking-under-lock"
+        assert {"checker", "file", "line", "message", "id"} <= set(out[0])
+
+    def test_missing_baseline_file_is_empty_baseline(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "a.py").write_text(self._VIOLATION)
+        missing = tmp_path / "nope.json"
+        assert self._main(
+            ["--root", str(pkg), "--baseline", str(missing)]
+        ) == 1
 
 
 class TestWitnessExport:

@@ -138,6 +138,36 @@ class TestClientKeyCache:
         assert kc.lookup("s") is not None
 
 
+class TestKeyCacheRankNamespace:
+    def test_same_sig_different_rank_coexist(self):
+        kc = ClientKeyCache(cap=8, ttl_s=10.0, max_stale_s=10.0)
+        kc.put((0, "s"), KEYS, np.zeros((8, 1), np.float32), 1, rank=0)
+        kc.put((1, "s"), KEYS, np.ones((8, 1), np.float32), 1, rank=1)
+        assert len(kc) == 2
+        assert float(kc.lookup((0, "s")).values[0, 0]) == 0.0
+        assert float(kc.lookup((1, "s")).values[0, 0]) == 1.0
+
+    def test_invalidation_is_rank_scoped(self):
+        kc = ClientKeyCache(cap=8, ttl_s=10.0, max_stale_s=10.0)
+        kc.put((0, "s"), KEYS, np.zeros((8, 1), np.float32), 1, rank=0)
+        kc.put((1, "s"), KEYS, np.zeros((8, 1), np.float32), 1, rank=1)
+        # rank 0's push touches the same LOCAL key ints — rank 1's
+        # entry (different rows entirely) must survive
+        assert kc.invalidate_keys(KEYS, rank=0) == 1
+        assert kc.lookup((0, "s")) is None
+        assert kc.lookup((1, "s")) is not None
+
+    def test_eviction_unindexes_the_right_namespace(self):
+        kc = ClientKeyCache(cap=1, ttl_s=10.0, max_stale_s=10.0)
+        kc.put((0, "s"), KEYS, np.zeros((8, 1), np.float32), 1, rank=0)
+        kc.put((1, "s"), KEYS, np.zeros((8, 1), np.float32), 1, rank=1)
+        assert kc.lookup((0, "s")) is None  # evicted (cap=1)
+        # the evicted rank-0 index rows are gone: invalidating rank 0
+        # drops nothing, rank 1 still drops its entry
+        assert kc.invalidate_keys(KEYS, rank=0) == 0
+        assert kc.invalidate_keys(KEYS, rank=1) == 1
+
+
 class TestVersionedPull:
     def test_pull_reply_carries_version_and_push_bumps_it(self):
         srv = ShardServer(
@@ -318,6 +348,50 @@ class TestServingHandle:
             h1.shutdown()
             h1.close()
             h2.close()
+
+    def test_shared_cache_across_shards_is_rank_scoped(self):
+        """The PR-7 carry-over (ISSUE 8): ONE cache serves a MULTI-SHARD
+        frontend. Keys are range-relative, so two shards produce the
+        same digest for different rows — entries must key by
+        (rank, sig) and invalidation by (rank, key), or shard A's rows
+        answer shard B's pulls and A's pushes evict B's entries."""
+        sA = ShardServer(
+            Sgd(eta=1.0), KeyRange(0, 256), serve_cfg=_serve_cfg()
+        ).start()
+        sB = ShardServer(
+            Sgd(eta=1.0), KeyRange(256, 512), serve_cfg=_serve_cfg()
+        ).start()
+        cfg = PSConfig()
+        cfg.serve = _serve_cfg()
+        shared = ClientKeyCache(cap=64, ttl_s=10.0, max_stale_s=60.0)
+        hA = ServerHandle(sA.address, 0, 0, cfg, range_size=256,
+                          serving=True, key_cache=shared)
+        hB = ServerHandle(sB.address, 1, 0, cfg, range_size=256,
+                          serving=True, key_cache=shared)
+        try:
+            # move shard B's rows so the two shards genuinely differ
+            hB.push(KEYS, -np.ones(8, np.float32))
+            wA = hA.pull(KEYS)  # same LOCAL keys, different shards
+            wB = hB.pull(KEYS)
+            np.testing.assert_allclose(wA, np.zeros(8, np.float32))
+            np.testing.assert_allclose(wB, np.ones(8, np.float32))
+            assert len(shared) == 2  # two entries, not one collision
+            # exactness across shards: A's push must invalidate ONLY
+            # A's entry — B keeps serving locally
+            hA.push(KEYS, -np.ones(8, np.float32))
+            pulls_b = sB.counters["pulls"]
+            np.testing.assert_allclose(
+                hB.pull(KEYS), np.ones(8, np.float32)
+            )
+            assert sB.counters["pulls"] == pulls_b  # still a local hit
+            np.testing.assert_allclose(
+                hA.pull(KEYS), np.ones(8, np.float32)
+            )
+        finally:
+            hA.shutdown()
+            hA.close()
+            hB.shutdown()
+            hB.close()
 
     def test_training_tier_bypasses_cache(self):
         """Even with [serve] cache on, a non-serving handle (the training
