@@ -1284,6 +1284,38 @@ def child_wire_rpc() -> dict:
         out["wire_comp_skipped"] = (
             wire_counters.get("wire_comp_skipped") - skipped0
         )
+
+        # flight-recorder overhead guard (ISSUE 9 acceptance: armed push
+        # throughput within 5% of disarmed). Interleaved off/on rounds so
+        # shared-host noise hits both sides of a round alike; configure()
+        # rebinds the module-level record between the identity-pinned
+        # no-op and the live ring append, which is exactly what the
+        # always-on instrumentation pays in production.
+        import tempfile as tmp_mod
+
+        from parameter_server_tpu.utils import flightrec
+
+        bb_dir = tmp_mod.mkdtemp(prefix="psbb_bench_")
+        fr_rounds = []
+        for _ in range(5):
+            flightrec.configure(None)
+            off = _rps_pipelined(400)
+            flightrec.configure(
+                bb_dir, process_name="bench-wire_rpc",
+                flush_interval_s=0, watchdog_interval_s=60,
+            )
+            on = _rps_pipelined(400)
+            fr_rounds.append((off, on))
+        flightrec.configure(None)
+        out["push_rps_flightrec_off"] = round(
+            stats.median(r[0] for r in fr_rounds), 1
+        )
+        out["push_rps_flightrec_on"] = round(
+            stats.median(r[1] for r in fr_rounds), 1
+        )
+        out["flightrec_ratio"] = round(
+            stats.median(on / off for off, on in fr_rounds), 3
+        )
         lockstep.close()
         pipelined.close()
     finally:

@@ -138,6 +138,12 @@ def _build_parser() -> argparse.ArgumentParser:
         "into this dir; merge with utils/trace.py:merge_trace_dir and "
         "open in Perfetto",
     )
+    la.add_argument(
+        "--blackbox_dir", default="",
+        help="arm the flight recorder + stall watchdog on EVERY spawned "
+        "node via PS_BLACKBOX_DIR: each process leaves a black-box dump "
+        "behind for `cli postmortem` to merge",
+    )
 
     st = sub.add_parser(
         "stats",
@@ -147,6 +153,26 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     st.add_argument(
         "--scheduler", required=True, help="coordinator host:port"
+    )
+
+    pm = sub.add_parser(
+        "postmortem",
+        help="merge the black-box dumps of a crashed/stalled cluster "
+        "(PS_BLACKBOX_DIR, utils/flightrec.py) into one causal "
+        "timeline: cross-process (cid, seq) stitching, anomaly flags "
+        "(stalls, acked-but-unapplied pushes, version regressions, "
+        "reconnects without heals, shed storms), per-key heat, and an "
+        "optional Perfetto-loadable rendering",
+    )
+    pm.add_argument("dir", help="the blackbox dump directory")
+    pm.add_argument(
+        "--trace_out", default="",
+        help="also write the merged timeline as Chrome trace-event JSON "
+        "(open in Perfetto next to a PS_TRACE_DIR trace of the run)",
+    )
+    pm.add_argument(
+        "--tail", type=int, default=40,
+        help="merged-timeline events to print in the human report",
     )
 
     li = sub.add_parser(
@@ -592,6 +618,15 @@ def main(argv: list[str] | None = None) -> int:
         # no config file: stats only needs a live coordinator address
         print(json.dumps(run_stats(args), default=float))
         return 0
+    if args.cmd == "postmortem":
+        # no config file: a postmortem works from the dumps alone
+        from parameter_server_tpu.utils.postmortem import postmortem
+
+        out = postmortem(args.dir, trace_out=args.trace_out, tail=args.tail)
+        print(out.pop("report"))
+        print(json.dumps(out, default=float))
+        # anomalies => nonzero, so a soak harness can gate on the exit
+        return 1 if out["anomalies"] else 0
     cfg = load_config(args.app_file)
     if getattr(args, "trace_dir", ""):
         # flag wins over both the config and the ambient env; run_node /
@@ -632,7 +667,7 @@ def main(argv: list[str] | None = None) -> int:
         out = launch_local(
             args.app_file, args.num_servers, args.num_workers, args.model_out,
             fault_plan=args.fault_plan, fault_seed=args.fault_seed,
-            trace_dir=args.trace_dir,
+            trace_dir=args.trace_dir, blackbox_dir=args.blackbox_dir,
         )
     print(json.dumps(out, default=float))
     return 0

@@ -100,7 +100,8 @@ import numpy as np
 from parameter_server_tpu.parallel.chaos import FaultPlan
 from parameter_server_tpu.parallel.ssp import SSPClock
 from parameter_server_tpu.parallel.workload import WorkloadPool
-from parameter_server_tpu.utils import trace
+from parameter_server_tpu.utils import flightrec, trace
+from parameter_server_tpu.utils.flightrec import watchdog
 from parameter_server_tpu.utils.heartbeat import HeartbeatMonitor
 from parameter_server_tpu.utils.metrics import (
     Histogram,
@@ -823,6 +824,13 @@ class RpcServer:
         ) -> None:
             nonlocal hi_n, lo_n, hi_frames, lo_frames
             fb, n = build_frame(rep, rep_arrays, bin_hdr=bin_hdr)
+            # flight recorder: the reply side of the frame ledger (the
+            # request side records at dispatch) — rseq is the caller's
+            # seq echo, the postmortem's stitch key
+            flightrec.record(
+                "rpc.out", rseq=rep.get("_rseq"),
+                ok=rep.get("ok", True), n=n,
+            )
             if hi:
                 hi_bufs.extend(fb)
                 hi_n += n
@@ -955,6 +963,11 @@ class RpcServer:
                     else None
                 )
                 cmd_name = header.get("cmd", "?")
+                # flight recorder: every received frame with its dedup
+                # identity — what the postmortem stitches across processes
+                flightrec.record(
+                    "rpc.in", cmd=cmd_name, cid=cid, seq=seq, n=nbytes,
+                )
                 # copy BEFORE dispatch: handlers mutate the header (pop cmd)
                 dup_header = (
                     dict(header)
@@ -1265,6 +1278,7 @@ class RpcClient:
         self._adapt_n = 0
         self._adapt_peak = 0
         self._ema_p50 = 0.0
+        self._completed_n = 0  # watchdog probe: replies matched to futures
         self._rng = random.Random()  # backoff jitter: no determinism contract
         self._cv = threading.Condition()  # guards all connection/pending state
         # serializes actual socket writes (inline fast path vs the writer
@@ -1312,10 +1326,12 @@ class RpcClient:
         self._peer_features = frozenset()
         self._sock = sock
         threading.Thread(
-            target=self._read_loop, args=(sock, self._gen), daemon=True
+            target=self._read_loop, args=(sock, self._gen), daemon=True,
+            name="ps-rpc-reader",
         ).start()
         threading.Thread(
-            target=self._write_loop, args=(sock, self._gen), daemon=True
+            target=self._write_loop, args=(sock, self._gen), daemon=True,
+            name="ps-rpc-writer",
         ).start()
 
     # -- completion side --------------------------------------------------
@@ -1371,6 +1387,11 @@ class RpcClient:
         # transparent retries/reconnects this call absorbed
         dt = time.perf_counter() - p.t0
         latency_histograms.observe(f"client.{p.cmd}", dt)
+        self._completed_n += 1  # GIL-atomic; feeds the stall probe
+        flightrec.record(
+            "rpc.reply", cmd=p.cmd, cid=self._cid, seq=p.seq,
+            ok=rep.get("ok", True),
+        )
         if self._adaptive:
             self._lat_hist.observe(dt)
             self._adapt_n += 1
@@ -1441,6 +1462,9 @@ class RpcClient:
     def _conn_died(self, sock: socket.socket, gen: int) -> None:
         """A connection failed under its reader (or a sender): tear it
         down and, when requests are stranded in flight, run the heal."""
+        flightrec.record(
+            "rpc.conn_died", addr=self._address, cid=self._cid, gen=gen,
+        )
         heal = False
         with self._cv:
             if self._closed or self._gen != gen:
@@ -1468,6 +1492,9 @@ class RpcClient:
         future fails with ConnectionError."""
         wire_counters.inc("rpc_retries")
         trace.instant("rpc.retry", cat="rpc", addr=self._address)
+        flightrec.record(
+            "rpc.heal.begin", addr=self._address, cid=self._cid,
+        )
         deadline = time.monotonic() + self._reconnect_timeout_s
         attempt = 0
         while True:
@@ -1558,12 +1585,19 @@ class RpcClient:
             with self._cv:
                 self._healing = False
                 self._cv.notify_all()
+            flightrec.record(
+                "rpc.healed", addr=self._address, cid=self._cid,
+                resent=len(pend),
+            )
             return
 
     def _abort_heal(self, exc: Exception) -> None:
         """Fail every pending future and release the heal. Futures complete
         OUTSIDE the lock: a done-callback may issue a follow-up call on
         this client, and ``_cv`` is not reentrant."""
+        flightrec.record(
+            "rpc.heal.failed", addr=self._address, cid=self._cid,
+        )
         with self._cv:
             failed = list(self._pending.values())
             self._pending.clear()
@@ -1626,6 +1660,9 @@ class RpcClient:
                     header["_trace"] = ctx
                 p = _PendingCall(_seq, cmd, header, arrays, _retry)
                 self._pending[_seq] = p
+                flightrec.record(
+                    "rpc.issue", cmd=cmd, cid=self._cid, seq=_seq,
+                )
                 if len(self._pending) > self._adapt_peak:
                     self._adapt_peak = len(self._pending)
                 wire_counters.observe_max(
@@ -1756,6 +1793,19 @@ class RpcClient:
             cmd, arrays, _retry=_retry, _seq=_seq, _inline=True, **fields
         )
         return fut.result()
+
+    def stall_probe(self) -> tuple[bool, int]:
+        """Watchdog probe for data-plane clients (pull/push pipelines,
+        where no command legitimately parks): busy while requests are in
+        flight and no heal owns them; progress is matched completions —
+        a reader thread parked past every deadline is in-flight work
+        with no completions moving. Control clients (barrier/ssp_wait
+        park by design) must NOT be registered on this."""
+        with self._cv:
+            return (
+                bool(self._pending) and not self._healing and not self._closed,
+                self._completed_n,
+            )
 
     @property
     def identity(self) -> tuple[str, int]:
@@ -1895,6 +1945,10 @@ class Coordinator:
                 self._cv.notify_all()
             self._monitor.forget(nid)
             wire_counters.inc("workers_recovered")
+            flightrec.record(
+                "coord.dead_worker", rank=rank, node=nid,
+                requeued=len(requeued),
+            )
 
     # -- dispatch --------------------------------------------------------
 
@@ -2105,6 +2159,12 @@ class Coordinator:
         with self._cv:
             if self._clock is None:
                 self._clock = SSPClock(int(h["num_workers"]), int(h["max_delay"]))
+                # a wedged clock (workers parked, nothing finishing) is
+                # one of the stalls the watchdog exists to catch
+                watchdog.register(
+                    f"ssp-clock:{id(self._clock)}",
+                    self._clock.stall_probe,
+                )
         return {"ok": True}, {}
 
     def _cmd_ssp_wait(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
@@ -2131,6 +2191,9 @@ class Coordinator:
         if self._sweep_thread is not None:
             self._sweep_thread.join(timeout=5)
             self._sweep_thread = None
+        with self._cv:
+            if self._clock is not None:
+                watchdog.unregister(f"ssp-clock:{id(self._clock)}")
         self.server.stop()
 
 

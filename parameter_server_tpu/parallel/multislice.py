@@ -81,11 +81,13 @@ from parameter_server_tpu.parallel.control import (
     RpcClient,
     RpcServer,
 )
-from parameter_server_tpu.utils import trace
+from parameter_server_tpu.utils import flightrec, trace
 from parameter_server_tpu.utils.config import PSConfig, ServeConfig, ServerConfig
+from parameter_server_tpu.utils.flightrec import watchdog
 from parameter_server_tpu.utils.heartbeat import HeartbeatReporter, host_stats
 from parameter_server_tpu.utils.keyrange import KeyRange
 from parameter_server_tpu.utils.metrics import (
+    key_heat,
     observe_scalar,
     telemetry_snapshot,
     wire_counters,
@@ -369,7 +371,11 @@ class ShardServer:
         reference swap — every writer (batched apply, serial push,
         checkpoint load) goes through here, so a pull reply's ``ver``
         always identifies exactly the table its rows came from."""
-        self._pub = (new_state, self._pub[1] + 1)
+        ver = self._pub[1] + 1
+        self._pub = (new_state, ver)
+        # flight recorder: every publish, whatever the writer — the
+        # postmortem's version-regression detector reads this stream
+        flightrec.record("rcu.publish", ver=ver)
 
     @property
     def version(self) -> int:
@@ -470,6 +476,24 @@ class ShardServer:
     def _start_apply_thread(self) -> None:
         if self._apply_q is None or self._apply_thread is not None:
             return
+        # watchdog: a non-advancing apply engine is THE server stall the
+        # flight recorder exists to catch — busy means work queued or a
+        # batch mid-apply; progress is the completed-batch counter.
+        # The id suffix keeps the name unique per server INSTANCE: two
+        # servers over the same range (tests, a restart in-process)
+        # must never alias one registry entry, or one engine's exit
+        # would unregister the other's probe.
+        self._applying = False
+        self._wd_name = (
+            f"apply:{self.range.begin}-{self.range.end}:{id(self):x}"
+        )
+
+        def probe() -> tuple[bool, int]:
+            q = self._apply_q
+            busy = (q is not None and not q.empty()) or self._applying
+            return busy, self.counters["apply_batches"]
+
+        watchdog.register(self._wd_name, probe, thread_name="ps-apply")
         self._apply_thread = threading.Thread(
             target=self._apply_loop, daemon=True, name="ps-apply"
         )
@@ -527,36 +551,48 @@ class ShardServer:
         q = self._apply_q
         assert q is not None
         stop = self.server._stop
-        while not stop.is_set():
-            try:
-                first = q.get(timeout=0.2)
-            except queue_mod.Empty:
-                continue
-            batch = [first]
-            limit = self._eff_batch if self._adaptive_batch else self._max_batch
-            while len(batch) < limit:
+        try:
+            while not stop.is_set():
                 try:
-                    batch.append(q.get_nowait())
+                    first = q.get(timeout=0.2)
                 except queue_mod.Empty:
-                    break
-            if self._adaptive_batch:
-                self._adapt_batch(len(batch), q.qsize())
-            try:
-                self._apply_batch(batch)
-            except Exception:  # noqa: BLE001 — isolate the offender
-                # one malformed push (bad grad shape, poison payload)
-                # must not fail the innocent pushes it happened to
-                # coalesce with — the serial path confined the error to
-                # its own request, so does the retry: each item re-runs
-                # as its own batch and only the offender's future fails
-                for p in batch:
-                    if p.future.done():
-                        continue
+                    continue
+                batch = [first]
+                limit = (
+                    self._eff_batch if self._adaptive_batch
+                    else self._max_batch
+                )
+                while len(batch) < limit:
                     try:
-                        self._apply_batch([p])
-                    except Exception as e1:  # noqa: BLE001
-                        if not p.future.done():
-                            p.future.set_exception(e1)
+                        batch.append(q.get_nowait())
+                    except queue_mod.Empty:
+                        break
+                if self._adaptive_batch:
+                    self._adapt_batch(len(batch), q.qsize())
+                self._applying = True
+                try:
+                    self._apply_batch(batch)
+                except Exception:  # noqa: BLE001 — isolate the offender
+                    # one malformed push (bad grad shape, poison payload)
+                    # must not fail the innocent pushes it happened to
+                    # coalesce with — the serial path confined the error
+                    # to its own request, so does the retry: each item
+                    # re-runs as its own batch and only the offender's
+                    # future fails
+                    for p in batch:
+                        if p.future.done():
+                            continue
+                        try:
+                            self._apply_batch([p])
+                        except Exception as e1:  # noqa: BLE001
+                            if not p.future.done():
+                                p.future.set_exception(e1)
+                finally:
+                    self._applying = False
+        finally:
+            # the watchdog must stop probing a dead engine (and a
+            # re-start() after stop re-registers a fresh probe)
+            watchdog.unregister(self._wd_name)
         self._apply_open = False
         deadline = time.monotonic() + 0.5  # grace: racing enqueuers land
         while time.monotonic() < deadline:
@@ -591,8 +627,10 @@ class ShardServer:
         rows, the whole batch recorded in the durable ledger atomically
         with the state publish (save_state can never snapshot a state
         that disagrees with its ledger)."""
+        flightrec.record("apply.begin", pushes=len(batch))
         todo: list[_QueuedPush] = []
         dups: list[_QueuedPush] = []
+        commit_ver = 0
         with self._lock:
             seen: set[tuple[str | None, str | None]] = set()
             for p in batch:
@@ -603,6 +641,9 @@ class ShardServer:
                         # server life: durably done — ack immediately
                         self._bump("push_replays")
                         wire_counters.inc("rpc_dedup_hits")
+                        flightrec.record(
+                            "apply.replay", cid=p.cid, seq=p.seq,
+                        )
                         if not p.future.done():
                             p.future.set_result(({"ok": True}, {}))
                         continue
@@ -649,6 +690,17 @@ class ShardServer:
                     # self.state without the lock and see the pre- or
                     # post-batch table, never a torn mix
                     self.state = new_state
+                    commit_ver = self.version
+        if todo:
+            # the postmortem's acked-vs-applied ledger: every (cid, seq)
+            # this commit made durable, against the version it produced
+            # (pairs capped — a 64-push batch still fits one ring slot)
+            flightrec.record(
+                "apply.commit", ver=commit_ver, pushes=len(todo),
+                pairs=[
+                    [p.cid, p.seq] for p in todo[:64] if p.cid is not None
+                ],
+            )
         with self._ctr_lock:
             self.counters["pushes"] += len(todo)
             self.counters["apply_batches"] += 1
@@ -814,6 +866,7 @@ class ShardServer:
                         # kill, and the resend must not re-apply
                         self._bump("push_replays")
                         wire_counters.inc("rpc_dedup_hits")
+                        flightrec.record("apply.replay", cid=cid, seq=seq)
                         return {"ok": True}, {}
             keys = self._resolve_keys(h, arrays)
             if keys is None:
@@ -821,6 +874,9 @@ class ShardServer:
                 # pin this bounce, so the keyed follow-up (same seq) re-runs
                 return {"ok": True, "need_keys": True, "_transient": True}, {}
             g = self._decode_grad(h, arrays).reshape(len(keys), -1)
+            # per-key heat (ISSUE 9): pushed GLOBAL keys feed the
+            # count-min the replication/tier-promotion planes will read
+            key_heat.add(np.asarray(keys, np.int64) + self.range.begin)
             if (
                 self._apply_q is not None
                 and self._apply_thread is not None
@@ -858,7 +914,12 @@ class ShardServer:
                     }
                     if cid is not None:
                         self._record_push(cid, seq)
+                serial_ver = self.version
             self._bump("pushes")
+            flightrec.record(
+                "apply.commit", ver=serial_ver, pushes=1,
+                pairs=[[cid, seq]] if cid is not None else [],
+            )
             return {"ok": True}, {}
         if cmd == "dump":
             state = self.state  # RCU snapshot (see pull)
@@ -949,6 +1010,7 @@ class ShardServer:
             self._bump("pulls")
             self._bump("shed")
             wire_counters.inc("serve_shed")
+            flightrec.record("serve.shed", sig=h.get("sig"))
             return {"ok": True, "not_modified": True, "shed": True,
                     "retry_after_ms": self._serve_cfg.retry_after_ms}, {}
         qn = int(h.get("quant", 0))
@@ -1026,6 +1088,11 @@ class ShardServer:
         revalidation traffic, ranges within ``snapshot_keys_max``); an
         already-current snapshot serves every pull either way, and
         everything else keeps the per-row jax path."""
+        # per-key heat, read side: only REAL row encodes count (a
+        # not_modified / shed / single-flight-reused reply moves no
+        # rows, so it adds no promotion-relevant heat — and the serving
+        # fast paths stay sketch-free)
+        key_heat.add(np.asarray(keys, np.int64) + self.range.begin)
         cur = self._host_w
         if cur is not None and cur[0] == ver:
             # a snapshot for THIS version is already materialized (some
@@ -1223,6 +1290,17 @@ class ServerHandle:
         # calls: a reader thread completing a failed future must never run
         # the blocking reconnect loop itself
         self._recovery_pool: ThreadPoolExecutor | None = None
+        # watchdog: this handle's client carries only pull/push/dump/stats
+        # (nothing that legitimately parks), so in-flight requests whose
+        # completions stop moving mean a reader parked past every
+        # deadline — one of the stalls the flight recorder dumps on.
+        # ``self.client`` is re-read per poll, so the probe follows
+        # recovery rebuilds.
+        self._wd_name = f"handle:{rank}:w{worker}:{id(self):x}"
+        watchdog.register(
+            self._wd_name, lambda: self.client.stall_probe(),
+            thread_name="ps-rpc-reader",
+        )
         if self._codec_bytes:
             from parameter_server_tpu.filters.fixed_point import FixedPointCodec
 
@@ -1863,6 +1941,7 @@ class ServerHandle:
         self.client.call("shutdown")
 
     def close(self) -> None:
+        watchdog.unregister(self._wd_name)
         self.client.close()
         if self._recovery_pool is not None:
             self._recovery_pool.shutdown(wait=False)
@@ -1943,8 +2022,17 @@ class _Beats:
             self._sink, node_id, interval_s, stats_fn=beat_stats
         )
         self._rep.start()
+        # watchdog: heartbeat silence, seen from INSIDE the silent node —
+        # the beat thread is always "busy" (liveness is its whole job),
+        # so a beats counter that stops advancing is a wedged reporter
+        self._wd_name = f"heartbeat:{node_id}"
+        watchdog.register(
+            self._wd_name, lambda: (True, self._rep.beats),
+            thread_name="ps-heartbeat",
+        )
 
     def stop(self) -> None:
+        watchdog.unregister(self._wd_name)
         self._rep.stop()
         self._sink.close()
 
@@ -2391,6 +2479,7 @@ def launch_local(
     fault_seed: int = 0,
     trace_dir: str = "",
     trace_sample: int = 1,
+    blackbox_dir: str = "",
 ) -> dict[str, Any]:
     """Spawn scheduler + servers + workers as real processes on this host
     (ref: script/local.sh — the de-facto integration test harness).
@@ -2414,6 +2503,12 @@ def launch_local(
     EVERY spawned node's RpcServers via the PS_FAULT_PLAN env var —
     frame-level drop/delay/disconnect/duplicate chaos on top of (or
     instead of) the process-kill fault.
+
+    ``blackbox_dir`` arms the flight recorder + watchdog on every
+    spawned node via the PS_BLACKBOX_DIR env var (the PS_TRACE_DIR
+    pattern): each process leaves a ``blackbox-<role>-<rank>-<pid>.json``
+    dump behind — periodically flushed, so even a SIGKILL'd node's box
+    survives for ``cli postmortem`` to merge.
     """
     import os
     import socket as socket_mod
@@ -2444,6 +2539,11 @@ def launch_local(
             # drop them, consistently with every other node (the
             # decision is keyed off the trace id, not the process)
             child_env[trace.TRACE_SAMPLE_ENV] = str(int(trace_sample))
+    if blackbox_dir:
+        # arm the flight recorder on EVERY spawned node (same pattern):
+        # any soak failure then leaves a postmortem behind
+        os.makedirs(blackbox_dir, exist_ok=True)
+        child_env[flightrec.BLACKBOX_DIR_ENV] = blackbox_dir
     _export_witness_env(child_env)
 
     import tempfile
@@ -2602,6 +2702,18 @@ def run_node(
             tdir, capacity=cfg.trace.capacity,
             process_name=f"{role}-{rank}",
             sample=sample,
+        )
+    # arm the black box: config [blackbox] dir wins, then the inherited
+    # PS_BLACKBOX_DIR (launch_local's arming path) — re-configured even
+    # when env-armed at import so the dump carries a role-rank name
+    bdir = cfg.blackbox.dir or os.environ.get(flightrec.BLACKBOX_DIR_ENV, "")
+    if bdir:
+        flightrec.configure(
+            bdir, capacity=cfg.blackbox.capacity,
+            process_name=f"{role}-{rank}",
+            flush_interval_s=cfg.blackbox.flush_interval_s,
+            watchdog_interval_s=cfg.blackbox.watchdog_interval_s,
+            stall_timeout_s=cfg.blackbox.stall_timeout_s,
         )
     if role == "scheduler":
         host, port = scheduler.rsplit(":", 1)

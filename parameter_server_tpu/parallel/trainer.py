@@ -42,7 +42,7 @@ from parameter_server_tpu.parallel.spmd import (
 )
 from parameter_server_tpu.parallel.ssp import DispatchWindow, SSPClock
 from parameter_server_tpu.parallel.workload import WorkloadPool
-from parameter_server_tpu.utils import trace
+from parameter_server_tpu.utils import flightrec, trace
 from parameter_server_tpu.utils.config import PSConfig
 from parameter_server_tpu.utils.metrics import ProgressReporter, timers
 
@@ -501,6 +501,9 @@ class PodTrainer:
                     timers.timer("trainer.retire"):
                 losses = np.atleast_1d(np.asarray(loss_arr))
                 exs = np.atleast_1d(np.asarray(examples_arr))
+            # flight recorder: the trainer's dispatch/retire cadence —
+            # "which step was in flight when the pod wedged"
+            flightrec.record("step.retire", step=step, examples=int(n))
             self.clock.finish(0, step)
             # empties only ever trail real batches within a group, so the
             # LAST microstep's pod-wide count is the drained signal
@@ -613,6 +616,7 @@ class PodTrainer:
                     self.state, out = self.step_fn(
                         self.state, stacked, step_idx * K
                     )
+                flightrec.record("step.dispatch", step=step_idx, examples=int(n))
                 self.examples_seen += n
                 n_since += n
                 gate.add(

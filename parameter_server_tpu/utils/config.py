@@ -363,6 +363,28 @@ class TraceConfig:
 
 
 @dataclass
+class BlackboxConfig:
+    """Black-box flight recorder + stall watchdog + postmortem dumps
+    (utils/flightrec.py). ``dir`` arms the always-on ring recorder and
+    the per-process watchdog on every process this config reaches; the
+    ``PS_BLACKBOX_DIR`` env var arms processes the config never touches
+    (spawned children — the PS_FAULT_PLAN / PS_TRACE_DIR pattern).
+    Dumps land as ``blackbox-<role>-<rank>-<pid>.json`` for
+    ``cli postmortem <dir>`` to merge."""
+
+    dir: str = ""  # "" = disabled (the identity-pinned no-op path)
+    capacity: int = 4096  # event ring bound per process
+    # periodic re-dump cadence: what a SIGKILL'd process leaves behind
+    # is at most this stale; 0 disables the flusher (trigger dumps only)
+    flush_interval_s: float = 1.0
+    # watchdog sampling cadence and the no-progress-while-busy window
+    # after which a registered source (apply engine, SSP clock, pipeline
+    # reader, heartbeat thread) is declared stalled and dumped
+    watchdog_interval_s: float = 1.0
+    stall_timeout_s: float = 30.0
+
+
+@dataclass
 class PSConfig:
     """Top-level app config (ref: linear_method.proto LinearMethodConfig)."""
 
@@ -383,6 +405,7 @@ class PSConfig:
     serve: ServeConfig = field(default_factory=ServeConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
+    blackbox: BlackboxConfig = field(default_factory=BlackboxConfig)
     model_output: str = ""
     report_interval: int = 1  # progress print cadence, in reports (ref gflag)
     seed: int = 0
@@ -428,6 +451,7 @@ _NESTED = {
     "serve": ServeConfig,
     "fault": FaultConfig,
     "trace": TraceConfig,
+    "blackbox": BlackboxConfig,
 }
 
 

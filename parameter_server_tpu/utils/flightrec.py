@@ -1,0 +1,443 @@
+"""Black-box flight recorder + stall watchdog + crash dumps.
+
+Reference analog: none — the reference debugged dead parameter servers
+with glog files and gdb on the corpse. This module is the aviation
+answer instead: every process keeps an **always-on, lock-light, bounded
+ring** of the last few thousand structured events (RPC frames in/out
+with cid/seq/cmd, apply-batch begin/commit with the RCU version, RCU
+publishes, reconnect/heal transitions, SSP clock movements, shed
+decisions, heartbeats), and when something goes wrong — a stalled apply
+thread, a wedged SSP clock, an unhandled thread exception, a fatal
+signal, a chaos-soak assertion — the whole box (ring + telemetry
+snapshot + every thread's stack) lands as one atomic JSON dump in
+``PS_BLACKBOX_DIR``. ``cli postmortem <dir>`` (utils/postmortem.py)
+merges the per-node dumps into one causal timeline.
+
+Design constraints, in order (the PR-2 tracer's contract, restated):
+
+1. **Disabled is free.** The module-level ``record`` is an
+   identity-pinned no-op function while the recorder is disarmed —
+   no event tuple, no buffer append, nothing for the GC (tests assert
+   ``record is _noop_record``). Instrumentation therefore lives
+   permanently on the wire/apply/clock hot paths.
+2. **Armed is lock-light.** The ring is a ``deque(maxlen=capacity)``;
+   ``append`` is GIL-atomic, so recording takes NO lock — a recorder
+   must never become the contention it exists to diagnose.
+3. **Survives the crash.** A background flusher re-dumps the box every
+   ``flush_interval_s`` while armed, so even a SIGKILL'd process leaves
+   an at-most-one-interval-stale box behind — the property the chaos
+   soak's kill drills rely on. Trigger dumps (watchdog, excepthook,
+   SIGTERM, atexit) are immediate; ``faulthandler`` covers the truly
+   fatal signals with a ``.crash.txt`` sidecar.
+
+Event schema (the ``psl``-style wire of the dump): each ring entry is
+``[wall_ts_seconds, thread_ident, etype, fields]`` with ``fields`` a
+small JSON-safe dict (the dump's thread table maps idents to
+names/native ids). Call sites keep fields scalar (cid/seq/cmd/ver/rank) so
+a dump stays a few hundred KB. The dump document::
+
+    {"schema": "psbb/1", "process": name, "pid": ..., "reason": ...,
+     "trigger_reasons": [...], "wall_time": ..., "events": [...],
+     "telemetry": telemetry_snapshot(), "threads": [{name, ident,
+     native_id, daemon, stack}], "stall": {...} | null}
+
+Arming (the PS_FAULT_PLAN / PS_TRACE_DIR inheritance pattern): the
+``PS_BLACKBOX_DIR`` env var arms the import-time recorder so spawned
+multihost children inherit it for free; ``configure()`` re-arms
+explicitly (``[blackbox]`` config section / launch_local's
+``blackbox_dir=``).
+
+The **stall watchdog** rides along: layers register ``(busy, progress)``
+probes (``watchdog.register``) — the apply engine, the SSP clock, a
+handle's pipelined reader, the heartbeat thread — and one daemon thread
+per armed process samples them: a source that stays busy without its
+progress counter advancing for ``stall_timeout_s`` fires exactly once
+per stall episode, recording the event, bumping ``watchdog_stalls`` and
+dumping the box with the stalled source + thread named.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Callable
+
+BLACKBOX_DIR_ENV = "PS_BLACKBOX_DIR"
+
+#: ring default: ~4k events x ~100 B ~= a few hundred KB per dump
+DEFAULT_CAPACITY = 4096
+
+
+# -- the recorder -----------------------------------------------------------
+
+_dir: str | None = None
+_buf: deque | None = None
+_name: str = ""
+_reasons: list[str] = []  # trigger reasons, in firing order
+_stall_log: list[dict[str, Any]] = []  # every watchdog firing this life
+_dump_lock = threading.Lock()  # one dump writer at a time
+_flush_stop: threading.Event | None = None
+_crash_file = None  # faulthandler sidecar handle (kept alive on purpose)
+
+
+def _noop_record(etype: str, **fields: Any) -> None:
+    """The disarmed path: identity-pinned (tests assert ``record is
+    _noop_record``) and allocation-free beyond the caller's kwargs."""
+
+
+def _live_record(etype: str, **fields: Any) -> None:
+    buf = _buf
+    if buf is not None:
+        # get_ident, NOT get_native_id: the ident is a userspace read
+        # (~0.1 us) where the native id is a gettid syscall that costs
+        # ~100x on un-vDSO'd kernels — on a per-frame hot path that
+        # difference IS the recorder's overhead budget. Dumps map ident
+        # -> name/native_id through their thread table.
+        buf.append((time.time(), threading.get_ident(), etype, fields))
+
+
+#: the module-level recording entry point every instrumented layer calls
+#: (``flightrec.record(...)``): rebound by configure() between the
+#: no-op and the live path, so the disabled cost is one attribute load +
+#: one call that does nothing
+record = _noop_record
+
+
+def enabled() -> bool:
+    return _dir is not None
+
+
+def blackbox_dir() -> str | None:
+    return _dir
+
+
+def events() -> list[tuple]:
+    """Snapshot of the ring (newest last); empty when disarmed."""
+    buf = _buf
+    return list(buf) if buf is not None else []
+
+
+def dump(reason: str, extra: dict[str, Any] | None = None) -> str | None:
+    """Atomically write this process's box (ring + telemetry + thread
+    stacks) into the armed dir; returns the path (None when disarmed).
+    One file per process — later dumps overwrite earlier ones, and
+    ``trigger_reasons`` keeps the firing history. Never raises: a dump
+    is last-ditch diagnostics and must not mask the original failure."""
+    d, buf = _dir, _buf
+    if d is None or buf is None:
+        return None
+    try:
+        if reason != "periodic" and len(_reasons) < 32:
+            # the flusher's cadence is not a trigger; real triggers keep
+            # a bounded firing history across overwrites
+            _reasons.append(reason)
+        threads = []
+        frames = sys._current_frames()
+        for t in threading.enumerate():
+            fr = frames.get(t.ident)
+            threads.append({
+                "name": t.name,
+                "ident": t.ident,
+                "native_id": getattr(t, "native_id", None),
+                "daemon": t.daemon,
+                "stack": traceback.format_stack(fr) if fr is not None else [],
+            })
+        from parameter_server_tpu.utils.metrics import (
+            telemetry_snapshot,
+            wire_counters,
+        )
+
+        wire_counters.inc("blackbox_dumps")
+        doc = {
+            "schema": "psbb/1",
+            "process": _name,
+            "pid": os.getpid(),
+            "reason": reason,
+            "trigger_reasons": list(_reasons),
+            "wall_time": time.time(),
+            "events": [list(e) for e in buf],
+            # observe-only: rolling here would consume the peak windows
+            # the heartbeat plane reports (the flusher dumps every second)
+            "telemetry": telemetry_snapshot(roll_peaks=False),
+            "threads": threads,
+            "stall": extra,
+            # the full watchdog firing history (dumps overwrite each
+            # other, so the triggering stall alone would lose earlier
+            # ones — e.g. the apply engine AND a handle reader both
+            # wedging on one fault)
+            "stalls": list(_stall_log),
+        }
+        path = os.path.join(d, f"blackbox-{_name}-{os.getpid()}.json")
+        tmp = path + f".tmp.{threading.get_native_id()}"
+        with _dump_lock:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)
+        return path
+    except Exception:  # noqa: BLE001 — diagnostics must never mask the crash
+        return None
+
+
+def _flush_loop(stop: threading.Event, interval_s: float) -> None:
+    """Periodic persistence: the half of the black box that survives
+    SIGKILL. Re-dumps only when the ring moved since the last flush."""
+    last_tail: tuple | None = None
+    while not stop.wait(interval_s):
+        buf = _buf
+        if buf is None:
+            return
+        tail = buf[-1] if buf else None
+        if tail is not last_tail:
+            last_tail = tail
+            dump("periodic")
+
+
+# -- crash hooks ------------------------------------------------------------
+
+_prev_threading_hook = None
+_hooks_installed = False
+
+
+def _thread_excepthook(args) -> None:  # pragma: no cover - exercised via tests
+    tname = args.thread.name if args.thread is not None else "?"
+    record(
+        "thread.exception", thread=tname,
+        exc=repr(args.exc_value),
+    )
+    dump(f"thread-exception:{tname}")
+    if _prev_threading_hook is not None:
+        _prev_threading_hook(args)
+
+
+def _sigterm_handler(signum, frame) -> None:  # pragma: no cover - signal path
+    record("signal", sig=int(signum))
+    # dump() takes the counter/telemetry/_dump locks; the handler runs on
+    # whichever thread the signal interrupted, and if THAT frame holds one
+    # of them an inline dump deadlocks and the process never dies. A
+    # helper thread + bounded join always reaches the re-kill — worst
+    # case the box is the flusher's, at most one interval stale.
+    t = threading.Thread(
+        target=dump, args=(f"signal:{signum}",), daemon=True,
+        name="ps-blackbox-sig",
+    )
+    t.start()
+    t.join(timeout=2.0)
+    signal.signal(signum, signal.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+def _atexit_dump() -> None:  # pragma: no cover - interpreter teardown
+    try:
+        dump("exit")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _install_hooks() -> None:
+    """Unhandled-thread-exception, SIGTERM and fatal-signal coverage.
+    Installed once per process, first arm; the hooks themselves check
+    the live armed state, so a later disarm makes them no-ops."""
+    global _prev_threading_hook, _hooks_installed, _crash_file
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+    _prev_threading_hook = threading.excepthook
+    threading.excepthook = _thread_excepthook
+    atexit.register(_atexit_dump)
+    try:
+        # SIGTERM: dump, then die with the default disposition. Only the
+        # main thread may install handlers; non-main arming skips it.
+        signal.signal(signal.SIGTERM, _sigterm_handler)
+    except (ValueError, OSError):
+        pass
+    try:
+        # truly fatal signals (SEGV/FPE/ABRT/BUS): python code cannot
+        # run, but faulthandler's C dumper can — sidecar text file
+        import faulthandler
+
+        _crash_file = open(
+            os.path.join(_dir, f"blackbox-{_name}-{os.getpid()}.crash.txt"),
+            "w",
+        )
+        faulthandler.enable(file=_crash_file)
+    except Exception:  # noqa: BLE001 — best-effort coverage
+        pass
+
+
+# -- stall watchdog ---------------------------------------------------------
+
+
+class _Source:
+    __slots__ = ("probe", "thread_name", "last", "mark", "fired")
+
+    def __init__(self, probe: Callable, thread_name: str):
+        self.probe = probe
+        self.thread_name = thread_name
+        self.last: Any = None
+        self.mark = time.monotonic()
+        self.fired = False
+
+
+class Watchdog:
+    """Per-process stall detector over registered progress probes.
+
+    A probe is ``() -> (busy, progress)``: ``busy`` means the source
+    currently has work it should be making progress on (a non-empty
+    apply queue, workers parked on the SSP gate, requests in a client's
+    pipeline window, a running heartbeat thread); ``progress`` is any
+    value that changes whenever real progress happens. A source that
+    stays busy with unchanged progress for ``stall_timeout_s`` fires
+    ONCE per stall episode (re-armed the moment progress resumes):
+    ``watchdog.stall`` event + ``watchdog_stalls`` counter + a blackbox
+    dump whose ``stall`` section names the source and its thread.
+
+    ``register``/``unregister`` are always cheap and safe to call —
+    probes only run while an armed recorder's watchdog thread (or a
+    test's explicit :meth:`poll`) drives them."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sources: dict[str, _Source] = {}
+        self._thread: threading.Thread | None = None
+        self._stop: threading.Event | None = None
+        self.interval_s = 1.0
+        self.stall_timeout_s = 30.0
+
+    def register(
+        self, name: str, probe: Callable, thread_name: str = ""
+    ) -> None:
+        with self._lock:
+            self._sources[name] = _Source(probe, thread_name)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def sources(self) -> list[str]:
+        with self._lock:
+            return sorted(self._sources)
+
+    def poll(self, now: float | None = None) -> list[str]:
+        """One sampling pass; returns the sources that fired (tests
+        drive this directly for determinism)."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            items = list(self._sources.items())
+        fired: list[str] = []
+        for name, s in items:
+            try:
+                busy, prog = s.probe()
+            except Exception:  # noqa: BLE001 — a dying probe is not a stall
+                continue
+            if not busy or prog != s.last:
+                s.last = prog
+                s.mark = now
+                s.fired = False
+                continue
+            if not s.fired and now - s.mark >= self.stall_timeout_s:
+                s.fired = True
+                fired.append(name)
+                self._fire(name, s, now - s.mark)
+        return fired
+
+    def _fire(self, name: str, s: _Source, stalled_s: float) -> None:
+        from parameter_server_tpu.utils.metrics import wire_counters
+
+        wire_counters.inc("watchdog_stalls")
+        record(
+            "watchdog.stall", source=name, thread=s.thread_name,
+            stalled_s=round(stalled_s, 3),
+        )
+        extra = {
+            "source": name,
+            "thread": s.thread_name,
+            "stalled_s": round(stalled_s, 3),
+        }
+        if len(_stall_log) < 32:
+            _stall_log.append(extra)
+        dump(f"stall:{name}", extra=extra)
+
+    def start(self, interval_s: float, stall_timeout_s: float) -> None:
+        self.interval_s = interval_s
+        self.stall_timeout_s = stall_timeout_s
+        if self._thread is not None:
+            return
+        self._stop = threading.Event()
+
+        def loop(stop: threading.Event) -> None:
+            while not stop.wait(self.interval_s):
+                self.poll()
+
+        self._thread = threading.Thread(
+            target=loop, args=(self._stop,), daemon=True, name="ps-watchdog"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        self._thread = None
+        self._stop = None
+
+
+#: process-global watchdog; layers register probes unconditionally (a
+#: dict entry), the sampling thread only runs while the box is armed
+watchdog = Watchdog()
+
+
+# -- arming -----------------------------------------------------------------
+
+
+def configure(
+    blackbox_dir: str | None,
+    capacity: int = DEFAULT_CAPACITY,
+    process_name: str = "",
+    flush_interval_s: float = 1.0,
+    watchdog_interval_s: float = 1.0,
+    stall_timeout_s: float = 30.0,
+) -> None:
+    """Arm (with a dir) or disarm (``""``/``None``) the recorder,
+    rebinding the module-level ``record`` between the live and the
+    identity-pinned no-op paths. Arming starts the periodic flusher and
+    the watchdog thread and installs the crash hooks; re-arming swaps
+    the ring (configure at process start, like the tracer)."""
+    global _dir, _buf, _name, _reasons, _stall_log, _flush_stop, record
+    # stop the previous incarnation's threads first (idempotent)
+    if _flush_stop is not None:
+        _flush_stop.set()
+        _flush_stop = None
+    watchdog.stop()
+    if not blackbox_dir:
+        _dir = None
+        _buf = None
+        record = _noop_record
+        return
+    os.makedirs(blackbox_dir, exist_ok=True)
+    _dir = blackbox_dir
+    _name = process_name or f"proc-{os.getpid()}"
+    _reasons = []
+    _stall_log = []
+    _buf = deque(maxlen=max(int(capacity), 1))
+    record = _live_record
+    _install_hooks()
+    if flush_interval_s > 0:
+        _flush_stop = threading.Event()
+        threading.Thread(
+            target=_flush_loop, args=(_flush_stop, flush_interval_s),
+            daemon=True, name="ps-blackbox-flush",
+        ).start()
+    watchdog.start(watchdog_interval_s, stall_timeout_s)
+
+
+# env-armed at import so spawned children need no plumbing (the
+# PS_FAULT_PLAN pattern); run_node re-configures with a role-rank name
+if os.environ.get(BLACKBOX_DIR_ENV):
+    configure(os.environ[BLACKBOX_DIR_ENV])

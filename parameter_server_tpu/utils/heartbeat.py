@@ -16,6 +16,8 @@ import os
 import threading
 import time
 
+from parameter_server_tpu.utils import flightrec
+
 
 def host_stats() -> dict:
     """CPU/mem snapshot for this process (ref: heartbeat_info fields)."""
@@ -125,16 +127,26 @@ class HeartbeatReporter:
         self._stats_fn = stats_fn
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        #: completed beats — the watchdog's heartbeat-silence progress
+        #: probe (a beat thread wedged in a dead sink stops advancing it)
+        self.beats = 0
 
     def start(self) -> "HeartbeatReporter":
-        self.monitor.beat(self.node_id, self._stats_fn())  # immediate first beat
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._beat_once()  # immediate first beat
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ps-heartbeat"
+        )
         self._thread.start()
         return self
 
+    def _beat_once(self) -> None:
+        self.monitor.beat(self.node_id, self._stats_fn())
+        self.beats += 1
+        flightrec.record("heartbeat.beat", node=self.node_id, n=self.beats)
+
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
-            self.monitor.beat(self.node_id, self._stats_fn())
+            self._beat_once()
 
     def stop(self) -> None:
         self._stop.set()

@@ -16,6 +16,8 @@ import time
 from pathlib import Path
 from typing import Any
 
+import numpy as np
+
 
 class CounterSet:
     """Thread-safe named monotonic counters (ref: the Postoffice per-node
@@ -30,6 +32,11 @@ class CounterSet:
 
     def __init__(self) -> None:
         self._d: dict[str, int] = {}
+        # windowed high-watermarks: the same *_peak gauges, but reset at
+        # every roll_peaks snapshot — so the telemetry plane reports
+        # peak-since-last-snapshot and a one-time spike DECAYS out of
+        # ``cli stats`` instead of latching forever (ISSUE 9 satellite)
+        self._win: dict[str, int] = {}
         self._lock = threading.Lock()
 
     def inc(self, name: str, n: int = 1) -> None:
@@ -46,24 +53,40 @@ class CounterSet:
 
     def observe_max(self, name: str, v: int) -> None:
         """High-watermark counter (e.g. ``rpc_inflight_peak``: the deepest
-        pipelined request window any connection actually reached)."""
+        pipelined request window any connection actually reached).
+        Tracked twice: cumulative (``get``/plain ``snapshot``) and per
+        telemetry window (``snapshot(roll_peaks=True)``)."""
         with self._lock:
             if v > self._d.get(name, 0):
                 self._d[name] = v
+            if v > self._win.get(name, 0):
+                self._win[name] = v
 
     def get(self, name: str) -> int:
         with self._lock:
             return self._d.get(name, 0)
 
-    def snapshot(self) -> dict[str, int]:
+    def snapshot(self, roll_peaks: bool = False) -> dict[str, int]:
+        """Counter snapshot. ``roll_peaks=True`` (the telemetry/heartbeat
+        path) reports each ``observe_max`` gauge's peak SINCE THE LAST
+        ROLL and resets that window — so the cluster dashboard shows
+        recent peaks, not peak-since-boot; ``get()`` and the default
+        snapshot keep the cumulative value for tests and process-exit
+        reporting."""
         with self._lock:
-            return dict(self._d)
+            out = dict(self._d)
+            if roll_peaks:
+                out.update(self._win)
+                for k in self._win:
+                    self._win[k] = 0
+            return out
 
     def reset(self) -> None:
         """Zero everything (tests only: production counters are cumulative
         for the life of the process, like the reference's)."""
         with self._lock:
             self._d.clear()
+            self._win.clear()
 
 
 #: process-global wire/recovery counters (see CounterSet docstring)
@@ -260,16 +283,209 @@ class TimerRegistry:
 timers = TimerRegistry()
 
 
-def telemetry_snapshot() -> dict[str, Any]:
+#: count-min hash seeds (splitmix64 salts; must agree across every node
+#: for the sketch tables to be mergeable by elementwise sum)
+_HEAT_SEEDS = (0x9E37, 0x85EB, 0xC2B2, 0x27D4)
+
+
+class KeyHeatSketch:
+    """Per-key access heat: a small count-min sketch over the GLOBAL key
+    ids touched by pulls and pushes, plus an exact hot-candidate list
+    (ISSUE 9 — the feed hot-key replication (#1) and tiered-store
+    promotion (#4) will consume).
+
+    Mergeable like the PR-2 histograms: same seeds + geometry on every
+    node, so tables sum elementwise and estimates stay one-sided
+    (count-min never under-counts). ``snapshot()`` is heartbeat-sized:
+    the sparse table rows ride along until they saturate
+    (``_SNAP_MAX_NNZ`` nonzeros), after which only the bounded
+    hot-candidate list travels — a terabyte-scale run degrades to
+    heavy-hitters-only, never to an unbounded beat payload."""
+
+    _SNAP_MAX_NNZ = 4096
+
+    def __init__(
+        self, width: int = 1024, depth: int = 2,
+        hot_min: int = 8, hot_cap: int = 64,
+    ):
+        if depth > len(_HEAT_SEEDS):
+            raise ValueError(f"depth <= {len(_HEAT_SEEDS)}")
+        self.width = int(width)
+        self.depth = int(depth)
+        self.hot_min = int(hot_min)
+        self.hot_cap = int(hot_cap)
+        self._t = np.zeros((self.depth, self.width), np.int64)
+        self._n = 0
+        self._hot: dict[int, int] = {}  # candidate key -> last estimate
+        self._lock = threading.Lock()
+
+    def _rows(self, keys: np.ndarray) -> np.ndarray:
+        from parameter_server_tpu.utils.hashing import splitmix64
+
+        k = np.asarray(keys).astype(np.uint64, copy=False)
+        out = np.empty((self.depth, len(k)), np.int64)
+        for d in range(self.depth):
+            with np.errstate(over="ignore"):
+                out[d] = (
+                    splitmix64(k ^ np.uint64(_HEAT_SEEDS[d]))
+                    % np.uint64(self.width)
+                ).astype(np.int64)
+        return out
+
+    def add(self, keys: np.ndarray) -> None:
+        """Count one access of each key (vectorized; GLOBAL key ids —
+        callers offset range-relative keys by their range begin)."""
+        keys = np.asarray(keys)
+        if len(keys) == 0:
+            return
+        idx = self._rows(keys)
+        # the sketch is process-global and every serving/decode thread
+        # feeds it, so the scatter (ufunc.at is slow) happens OUTSIDE
+        # the lock as a per-depth bincount; the critical section is one
+        # dense (depth, width) add + the gather
+        contrib = np.stack([
+            np.bincount(idx[d], minlength=self.width)
+            for d in range(self.depth)
+        ])
+        with self._lock:
+            self._t += contrib
+            self._n += len(keys)
+            est = self._t[np.arange(self.depth)[:, None], idx].min(axis=0)
+            hot = est >= self.hot_min
+            if hot.any():
+                for k, c in zip(keys[hot].tolist(), est[hot].tolist()):
+                    self._hot[int(k)] = int(c)
+                if len(self._hot) > 2 * self.hot_cap:
+                    top = sorted(
+                        self._hot.items(), key=lambda kv: -kv[1]
+                    )[: self.hot_cap]
+                    self._hot = dict(top)
+
+    def count(self, keys: np.ndarray) -> np.ndarray:
+        """Estimated access counts (never under-estimates)."""
+        keys = np.asarray(keys)
+        if len(keys) == 0:
+            return np.zeros(0, np.int64)
+        idx = self._rows(keys)
+        with self._lock:
+            return self._t[np.arange(self.depth)[:, None], idx].min(axis=0)
+
+    def snapshot(self) -> dict[str, Any]:
+        """Heartbeat-piggyback form ({} when nothing was counted): JSON
+        ints only, sparse rows while under the nnz budget."""
+        with self._lock:
+            if self._n == 0:
+                return {}
+            out: dict[str, Any] = {
+                "w": self.width, "d": self.depth, "n": int(self._n),
+                "hot": {str(k): int(c) for k, c in self._hot.items()},
+            }
+            nnz = int(np.count_nonzero(self._t))
+            if nnz <= self._SNAP_MAX_NNZ:
+                out["rows"] = [
+                    {
+                        str(i): int(c)
+                        for i, c in zip(
+                            np.nonzero(self._t[d])[0].tolist(),
+                            self._t[d][np.nonzero(self._t[d])].tolist(),
+                        )
+                    }
+                    for d in range(self.depth)
+                ]
+            else:
+                out["saturated"] = True
+            return out
+
+    def reset(self) -> None:
+        """Tests/benchmarks only (see CounterSet.reset)."""
+        with self._lock:
+            self._t[:] = 0
+            self._n = 0
+            self._hot.clear()
+
+
+#: process-global per-key heat (shard servers add touched pull/push keys)
+key_heat = KeyHeatSketch()
+
+
+def merge_heat_snapshots(snaps: list[dict[str, Any]]) -> dict[str, Any]:
+    """Cluster merge of KeyHeatSketch snapshots: tables sum elementwise
+    (same geometry/seeds everywhere), candidate lists sum per key.
+    Geometry mismatches and saturated tables degrade to candidates-only."""
+    snaps = [s for s in snaps if s]
+    if not snaps:
+        return {}
+    out: dict[str, Any] = {
+        "w": snaps[0].get("w"), "d": snaps[0].get("d"),
+        "n": sum(s.get("n", 0) for s in snaps),
+    }
+    hot: dict[str, int] = {}
+    for s in snaps:
+        for k, c in s.get("hot", {}).items():
+            hot[k] = hot.get(k, 0) + int(c)
+    out["hot"] = hot
+    rows: list[dict[str, int]] | None = None
+    for s in snaps:
+        sr = s.get("rows")
+        if sr is None or (s.get("w"), s.get("d")) != (out["w"], out["d"]):
+            rows = None
+            out["saturated"] = True
+            break
+        if rows is None:
+            rows = [dict(r) for r in sr]
+        else:
+            for d, r in enumerate(sr):
+                acc = rows[d]
+                for i, c in r.items():
+                    acc[i] = acc.get(i, 0) + int(c)
+    if rows is not None:
+        out["rows"] = rows
+    return out
+
+
+def heat_top(snap: dict[str, Any], k: int = 10) -> list[tuple[int, int]]:
+    """Top-k (key, estimated count) from a (possibly merged) heat
+    snapshot. With the sparse table present, candidate keys re-query the
+    merged table (consistent cluster-wide estimates); a saturated
+    snapshot falls back to the summed candidate counts."""
+    if not snap:
+        return []
+    cand = [int(key) for key in snap.get("hot", {})]
+    if not cand:
+        return []
+    rows = snap.get("rows")
+    if rows is not None:
+        sk = KeyHeatSketch(width=int(snap["w"]), depth=int(snap["d"]))
+        for d, r in enumerate(rows):
+            for i, c in r.items():
+                sk._t[d, int(i)] = int(c)
+        counts = sk.count(np.asarray(cand, np.uint64))
+        pairs = [(key, int(c)) for key, c in zip(cand, counts.tolist())]
+    else:
+        pairs = [(int(key), int(c)) for key, c in snap["hot"].items()]
+    pairs.sort(key=lambda kv: (-kv[1], kv[0]))
+    return pairs[:k]
+
+
+def telemetry_snapshot(roll_peaks: bool = True) -> dict[str, Any]:
     """This process's full telemetry state — counters, per-command
-    latency histograms, named timers. Small (sparse dicts), so nodes
-    piggyback it on every heartbeat and the coordinator merges the
-    cluster view without a second collection path."""
-    return {
-        "counters": wire_counters.snapshot(),
+    latency histograms, named timers, per-key heat. Small (sparse
+    dicts), so nodes piggyback it on every heartbeat and the coordinator
+    merges the cluster view without a second collection path. Peak
+    gauges roll here: each snapshot reports peak-since-last-snapshot
+    (see ``CounterSet.snapshot``). ``roll_peaks=False`` observes without
+    consuming the window — for readers that are not the telemetry plane
+    (the blackbox flusher dumps every second; if it rolled, heartbeats
+    and ``cli stats`` would always see ~0 peaks on an armed node)."""
+    out = {
+        "counters": wire_counters.snapshot(roll_peaks=roll_peaks),
         "hists": latency_histograms.snapshot(),
         "timers": timers.snapshot(),
     }
+    heat = key_heat.snapshot()
+    if heat:
+        out["key_heat"] = heat
+    return out
 
 
 def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
@@ -280,6 +496,7 @@ def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
     counters: dict[str, int] = {}
     hists: dict[str, list[dict]] = {}
     tmr: dict[str, dict[str, float]] = {}
+    heat: list[dict[str, Any]] = []
     for s in snaps:
         for k, v in s.get("counters", {}).items():
             if k.endswith("_peak"):
@@ -292,11 +509,16 @@ def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
             t = tmr.setdefault(k, {"total_s": 0.0, "count": 0})
             t["total_s"] += v.get("total_s", 0.0)
             t["count"] += v.get("count", 0)
-    return {
+        if s.get("key_heat"):
+            heat.append(s["key_heat"])
+    out = {
         "counters": counters,
         "hists": {k: merge_hist_snapshots(v) for k, v in hists.items()},
         "timers": tmr,
     }
+    if heat:
+        out["key_heat"] = merge_heat_snapshots(heat)
+    return out
 
 
 def format_latency_table(hists: dict[str, dict[str, Any]]) -> str:
@@ -344,6 +566,15 @@ def format_cluster_stats(rep: dict[str, Any]) -> str:
     ctr = merged.get("counters", {})
     for k in sorted(ctr):
         lines.append(f"  {k:<28} {ctr[k]}")
+    heat = merged.get("key_heat")
+    if heat:
+        lines.append("")
+        lines.append(
+            f"hot keys (count-min heat, {heat.get('n', 0)} accesses "
+            "counted, top 10):"
+        )
+        for key, c in heat_top(heat, 10):
+            lines.append(f"  key {key:<24} ~{c}")
     lines.append("")
     lines.append("per-command latency (merged across nodes):")
     lines.append(format_latency_table(merged.get("hists", {})))

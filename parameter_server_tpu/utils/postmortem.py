@@ -1,0 +1,414 @@
+"""Cluster postmortem: merge per-node black-box dumps into one causal
+timeline and name what went wrong.
+
+Input: a ``PS_BLACKBOX_DIR`` full of ``blackbox-<proc>-<pid>.json``
+dumps (utils/flightrec.py — one per process, written by the periodic
+flusher, the stall watchdog, crash hooks or at exit). Output, via
+``cli postmortem <dir>``:
+
+- a **merged timeline**: every process's ring events on one wall-clock
+  axis, each stamped with its process name/pid/tid;
+- **cross-process stitching**: RPC events carry (cid, seq), so one
+  logical push shows up as client ``rpc.issue`` -> server ``rpc.in`` ->
+  server ``apply.commit`` -> client ``rpc.reply`` — the postmortem's
+  analog of the tracing plane's trace-id propagation, but reconstructed
+  from the wreckage instead of recorded live;
+- **anomaly flags**: acked-but-unapplied pushes (a client holds an ok
+  push reply no surviving server ledgered), RCU version regressions
+  within one server life, reconnects that never healed, shed storms,
+  and any watchdog stall dumps (source + thread named);
+- a **Perfetto-loadable** rendering through the existing trace exporter
+  (``trace.write_chrome_trace``): load the merged timeline next to a
+  PR-2 trace of the same run;
+- the merged **per-key heat** view (telemetry ``key_heat`` snapshots
+  ride every dump) — which keys were hot when the music stopped.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from parameter_server_tpu.utils.metrics import heat_top, merge_heat_snapshots
+
+#: dump filename prefix (see flightrec.dump)
+_PREFIX = "blackbox-"
+
+
+def load_dumps(box_dir: str) -> list[dict[str, Any]]:
+    """Every parseable ``blackbox-*.json`` in the dir (skipping torn or
+    foreign files — a postmortem must work with whatever survived)."""
+    out: list[dict[str, Any]] = []
+    for fn in sorted(os.listdir(box_dir)):
+        if not (fn.startswith(_PREFIX) and fn.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(box_dir, fn)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if doc.get("schema") != "psbb/1":
+            continue
+        doc["_file"] = fn
+        out.append(doc)
+    return out
+
+
+def crash_sidecars(box_dir: str) -> list[str]:
+    """faulthandler ``.crash.txt`` sidecars present in the dir (a fatal
+    signal dumped C-level stacks there; surfaced, not parsed)."""
+    return sorted(
+        fn
+        for fn in os.listdir(box_dir)
+        if fn.startswith(_PREFIX) and fn.endswith(".crash.txt")
+        and os.path.getsize(os.path.join(box_dir, fn)) > 0
+    )
+
+
+def merge_timeline(dumps: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """All dumps' ring events on one wall-clock axis (ts ascending),
+    each normalized to {ts, proc, pid, tid, etype, args}."""
+    out: list[dict[str, Any]] = []
+    for d in dumps:
+        proc, pid = d.get("process", "?"), d.get("pid", 0)
+        for ev in d.get("events", []):
+            try:
+                ts, tid, etype, args = ev
+            except (TypeError, ValueError):
+                continue
+            out.append({
+                "ts": float(ts), "proc": proc, "pid": pid, "tid": tid,
+                "etype": etype, "args": args or {},
+            })
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def _call_key(ev: dict[str, Any]) -> tuple[str, str] | None:
+    a = ev["args"]
+    cid, seq = a.get("cid"), a.get("seq")
+    if cid is None or seq is None:
+        return None
+    return (str(cid), str(seq))
+
+
+def stitch_calls(
+    timeline: list[dict[str, Any]],
+) -> dict[tuple[str, str], list[dict[str, Any]]]:
+    """Group events by (cid, seq) — the wire's dedup identity doubles as
+    the postmortem's stitch key. ``apply.commit`` events contribute every
+    (cid, seq) pair in their batch."""
+    out: dict[tuple[str, str], list[dict[str, Any]]] = {}
+    for ev in timeline:
+        k = _call_key(ev)
+        if k is not None:
+            out.setdefault(k, []).append(ev)
+        for pair in ev["args"].get("pairs", ()):
+            try:
+                cid, seq = pair
+            except (TypeError, ValueError):
+                continue
+            if cid is None:
+                continue
+            out.setdefault((str(cid), str(seq)), []).append(ev)
+    return out
+
+
+def _applied_keys(
+    calls: dict[tuple[str, str], list[dict[str, Any]]],
+) -> set[tuple[str, str]]:
+    return {
+        k
+        for k, evs in calls.items()
+        if any(e["etype"] in ("apply.commit", "apply.replay") for e in evs)
+    }
+
+
+def find_anomalies(
+    dumps: list[dict[str, Any]],
+    timeline: list[dict[str, Any]],
+    shed_storm_n: int = 10,
+    shed_window_s: float = 1.0,
+) -> list[dict[str, Any]]:
+    """The flag list (each: {kind, detail fields...}), most severe first."""
+    out: list[dict[str, Any]] = []
+
+    # watchdog stalls: the dump itself names the sources and threads
+    # (the full firing history when present; older/synthetic dumps fall
+    # back to the trigger reasons + the last firing's extra)
+    for d in dumps:
+        stalls = d.get("stalls")
+        if stalls is None:
+            stalls = []
+            for r in d.get("trigger_reasons", []):
+                if not r.startswith("stall:"):
+                    continue
+                src = r[len("stall:"):]
+                st = d.get("stall") or {}
+                if st.get("source") not in (None, src):
+                    st = {}  # the extra belongs to a different firing
+                stalls.append({
+                    "source": st.get("source", src),
+                    "thread": st.get("thread", ""),
+                    "stalled_s": st.get("stalled_s"),
+                })
+        for st in stalls:
+            out.append({
+                "kind": "stall",
+                "proc": d.get("process"),
+                "source": st.get("source"),
+                "thread": st.get("thread", ""),
+                "stalled_s": st.get("stalled_s"),
+            })
+        for r in d.get("trigger_reasons", []):
+            if r.startswith("thread-exception"):
+                out.append({
+                    "kind": "thread-exception",
+                    "proc": d.get("process"),
+                    "detail": r,
+                })
+
+    calls = stitch_calls(timeline)
+    applied = _applied_keys(calls)
+
+    # acked-but-unapplied pushes: a client-side ok push reply whose
+    # (cid, seq) no server event ever ledgered — only judged when a
+    # server dump that saw THIS cid exists (otherwise the server's box
+    # simply didn't survive, which is absence of evidence), and only for
+    # acks inside that server ring's retained window. The ring is
+    # bounded: a server records more events per push than the client, so
+    # on a long healthy run the oldest client replies outlive their
+    # commits' ring slots — those are evictions, not anomalies. A commit
+    # always precedes the ack it triggers, so an ack at ts >= the
+    # server window start would have its commit retained.
+    win_start: dict[tuple[str, int], float] = {}
+    for ev in timeline:  # ts-sorted: first hit is each box's oldest event
+        win_start.setdefault((ev["proc"], ev["pid"]), ev["ts"])
+    server_cid_win: dict[str, float] = {}
+    for ev in timeline:
+        if ev["etype"] in ("rpc.in", "apply.commit", "apply.replay"):
+            cids = []
+            cid = ev["args"].get("cid")
+            if cid is not None:
+                cids.append(str(cid))
+            for pair in ev["args"].get("pairs", ()):
+                if pair and pair[0] is not None:
+                    cids.append(str(pair[0]))
+            w = win_start[(ev["proc"], ev["pid"])]
+            for c in cids:
+                server_cid_win[c] = min(server_cid_win.get(c, w), w)
+    for k, evs in sorted(calls.items()):
+        if k in applied or k[0] not in server_cid_win:
+            continue
+        ack_ts = max(
+            (
+                e["ts"]
+                for e in evs
+                if e["etype"] == "rpc.reply"
+                and e["args"].get("cmd") == "push"
+                and e["args"].get("ok", True)
+            ),
+            default=None,
+        )
+        if ack_ts is None or ack_ts < server_cid_win[k[0]]:
+            continue
+        out.append({
+            "kind": "acked-but-unapplied",
+            "cid": k[0], "seq": k[1],
+            "procs": sorted({e["proc"] for e in evs}),
+        })
+
+    # RCU version regressions within one process life (pid): versions
+    # are opaque but monotonic per life — a decrease means a rollback
+    # or a torn publish
+    last_ver: dict[tuple[str, int], int] = {}
+    for ev in timeline:
+        if ev["etype"] != "rcu.publish":
+            continue
+        ver = ev["args"].get("ver")
+        if ver is None:
+            continue
+        pk = (ev["proc"], ev["pid"])
+        prev = last_ver.get(pk)
+        if prev is not None and int(ver) < prev:
+            out.append({
+                "kind": "version-regression",
+                "proc": ev["proc"], "pid": ev["pid"],
+                "from": prev, "to": int(ver), "ts": ev["ts"],
+            })
+        last_ver[pk] = int(ver)
+
+    # reconnects without heals: a process whose heal attempts never
+    # landed — its peer died (or the net partitioned) and stayed gone
+    by_proc: dict[tuple[str, int], dict[str, int]] = {}
+    for ev in timeline:
+        if ev["etype"] in ("rpc.heal.begin", "rpc.healed", "rpc.heal.failed"):
+            c = by_proc.setdefault((ev["proc"], ev["pid"]), {})
+            c[ev["etype"]] = c.get(ev["etype"], 0) + 1
+    for (proc, pid), c in sorted(by_proc.items()):
+        begun = c.get("rpc.heal.begin", 0)
+        healed = c.get("rpc.healed", 0)
+        if begun > healed:
+            out.append({
+                "kind": "reconnect-without-heal",
+                "proc": proc, "pid": pid,
+                "begun": begun, "healed": healed,
+                "failed": c.get("rpc.heal.failed", 0),
+            })
+
+    # shed storms: admission control firing in bursts — readers were
+    # being bounced faster than the engine drained
+    sheds = [e["ts"] for e in timeline if e["etype"] == "serve.shed"]
+    lo = 0
+    for hi in range(len(sheds)):
+        while sheds[hi] - sheds[lo] > shed_window_s:
+            lo += 1
+        if hi - lo + 1 >= shed_storm_n:
+            out.append({
+                "kind": "shed-storm",
+                "count": hi - lo + 1,
+                "window_s": shed_window_s,
+                "ts": sheds[lo],
+            })
+            break
+    return out
+
+
+def merged_heat(dumps: list[dict[str, Any]]) -> dict[str, Any]:
+    """The cluster's per-key heat at dump time (telemetry piggyback)."""
+    return merge_heat_snapshots([
+        (d.get("telemetry") or {}).get("key_heat") or {} for d in dumps
+    ])
+
+
+def to_trace_events(timeline: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """The merged timeline as Chrome instant events (one Perfetto track
+    per process/thread, same schema the tracing plane exports)."""
+    return [
+        {
+            "name": ev["etype"],
+            "cat": "blackbox",
+            "ph": "i",
+            "s": "t",
+            "ts": ev["ts"] * 1e6,
+            "pid": ev["pid"],
+            "tid": ev["tid"],
+            "args": dict(ev["args"]),
+        }
+        for ev in timeline
+    ]
+
+
+def export_trace(
+    dumps: list[dict[str, Any]],
+    timeline: list[dict[str, Any]],
+    path: str,
+) -> str:
+    """Write the Perfetto-loadable rendering via the existing trace
+    exporter (thread names recovered from each dump's stack section)."""
+    from parameter_server_tpu.utils import trace
+
+    tnames: dict[tuple[int, int], str] = {}
+    for d in dumps:
+        for t in d.get("threads", []):
+            # events record thread IDENTS (the cheap id — see
+            # flightrec._live_record); the dump's thread table maps them
+            # back to names
+            ident = t.get("ident")
+            if ident is not None:
+                tnames[(d.get("pid", 0), ident)] = t.get("name", "")
+    return trace.write_chrome_trace(
+        to_trace_events(timeline), path,
+        process_names={
+            d.get("pid", 0): d.get("process", "?") for d in dumps
+        },
+        thread_names=tnames,
+    )
+
+
+def render_report(
+    dumps: list[dict[str, Any]],
+    timeline: list[dict[str, Any]],
+    anomalies: list[dict[str, Any]],
+    tail: int = 40,
+) -> str:
+    """The human postmortem: per-process box inventory, anomaly flags,
+    hot keys, and the merged timeline's tail."""
+    lines = [f"postmortem over {len(dumps)} process box(es)"]
+    lines.append("")
+    lines.append(
+        f"{'process':<18} {'pid':>7} {'events':>7} {'reason':<24} window"
+    )
+    for d in dumps:
+        evs = d.get("events", [])
+        window = (
+            f"{evs[0][0]:.3f} .. {evs[-1][0]:.3f}" if evs else "-"
+        )
+        lines.append(
+            f"{d.get('process', '?'):<18} {d.get('pid', 0):>7} "
+            f"{len(evs):>7} {str(d.get('reason', '?')):<24} {window}"
+        )
+    lines.append("")
+    if anomalies:
+        lines.append(f"ANOMALIES ({len(anomalies)}):")
+        for a in anomalies:
+            kind = a["kind"]
+            rest = ", ".join(
+                f"{k}={v}" for k, v in a.items() if k != "kind"
+            )
+            lines.append(f"  [{kind}] {rest}")
+    else:
+        lines.append("no anomalies flagged")
+    heat = merged_heat(dumps)
+    if heat:
+        lines.append("")
+        lines.append(
+            f"hot keys at dump time ({heat.get('n', 0)} accesses, top 10):"
+        )
+        for key, c in heat_top(heat, 10):
+            lines.append(f"  key {key:<24} ~{c}")
+    if timeline:
+        lines.append("")
+        lines.append(f"merged timeline (last {min(tail, len(timeline))} "
+                     f"of {len(timeline)} events):")
+        for ev in timeline[-tail:]:
+            args = " ".join(
+                f"{k}={v}" for k, v in sorted(ev["args"].items())
+                if k != "pairs"
+            )
+            lines.append(
+                f"  {ev['ts']:.6f} {ev['proc']:<14} tid={ev['tid']:<8} "
+                f"{ev['etype']:<20} {args}"
+            )
+    return "\n".join(lines)
+
+
+def postmortem(
+    box_dir: str, trace_out: str = "", tail: int = 40,
+) -> dict[str, Any]:
+    """End-to-end: load, merge, stitch, flag, render. Returns the
+    machine-readable summary (the CLI prints the human report first)."""
+    dumps = load_dumps(box_dir)
+    timeline = merge_timeline(dumps)
+    anomalies = find_anomalies(dumps, timeline)
+    calls = stitch_calls(timeline)
+    cross = sorted(
+        k for k, evs in calls.items()
+        if len({(e["proc"], e["pid"]) for e in evs}) >= 2
+    )
+    out: dict[str, Any] = {
+        "processes": len(dumps),
+        "events": len(timeline),
+        "stitched_calls": len(calls),
+        "cross_process_calls": len(cross),
+        "anomalies": anomalies,
+        "crash_sidecars": crash_sidecars(box_dir) if dumps else [],
+        "report": render_report(dumps, timeline, anomalies, tail=tail),
+    }
+    heat = merged_heat(dumps)
+    if heat:
+        out["heat_top"] = heat_top(heat, 10)
+    if trace_out:
+        out["trace_out"] = export_trace(dumps, timeline, trace_out)
+    return out

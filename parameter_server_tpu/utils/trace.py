@@ -406,24 +406,10 @@ class Tracer:
         """Write the buffered events as one strict Chrome trace-event JSON
         object (``ts``-sorted, with process/thread ``M`` metadata), the
         format Perfetto's legacy-JSON importer accepts."""
-        events = sorted(self.events(), key=lambda e: e["ts"])
-        pid = os.getpid()
-        meta: list[dict[str, Any]] = [{
-            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-            "args": {"name": self.process_name},
-        }]
-        for tid in sorted({e["tid"] for e in events}):
-            meta.append({
-                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
-                "args": {"name": f"thread-{tid}"},
-            })
-        doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-        os.replace(tmp, path)
-        return path
+        return write_chrome_trace(
+            self.events(), path,
+            process_names={os.getpid(): self.process_name},
+        )
 
     def flush(self) -> str | None:
         """Export into the armed trace dir (no-op when disabled or no
@@ -537,6 +523,41 @@ def traced(name: str | None = None, cat: str = "") -> Callable:
         return wrapper
 
     return deco
+
+
+def write_chrome_trace(
+    events: list[dict[str, Any]],
+    path: str,
+    process_names: dict[int, str] | None = None,
+    thread_names: dict[tuple[int, int], str] | None = None,
+) -> str:
+    """The exporter's file-writing core, shared with the postmortem
+    plane (utils/postmortem.py renders merged blackbox timelines through
+    it): ``ts``-sort the events, prepend process/thread ``M`` metadata,
+    atomically write one strict Chrome trace-event JSON object — the
+    format Perfetto's legacy-JSON importer accepts."""
+    events = sorted(events, key=lambda e: e.get("ts", 0))
+    meta: list[dict[str, Any]] = []
+    for pid, name in sorted((process_names or {}).items()):
+        meta.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name},
+        })
+    thread_names = thread_names or {}
+    for pid, tid in sorted(
+        {(e["pid"], e["tid"]) for e in events if "tid" in e}
+    ):
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": thread_names.get((pid, tid), f"thread-{tid}")},
+        })
+    doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
 
 
 def merge_trace_dir(trace_dir: str, out_name: str = "trace-merged.json") -> str:

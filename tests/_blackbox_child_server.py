@@ -1,0 +1,42 @@
+"""Child process for tests/test_blackbox.py: one shard-server process
+with the flight recorder armed via the PS_BLACKBOX_DIR env var — the
+exact inheritance path launch_local uses — and a fast periodic flush so
+the box it leaves behind is at most ~100 ms stale when the parent
+SIGKILLs it mid-window. A PS_FAULT_PLAN in the env arms frame chaos on
+its RpcServer the usual way.
+
+Usage: python _blackbox_child_server.py
+"""
+
+from __future__ import annotations
+
+
+def main() -> None:
+    import os
+
+    from parameter_server_tpu.kv.updaters import Sgd
+    from parameter_server_tpu.parallel.multislice import ShardServer
+    from parameter_server_tpu.utils import flightrec
+    from parameter_server_tpu.utils.keyrange import KeyRange
+
+    # env-armed at import already; re-configure for a readable dump name
+    # and a flush cadence tight enough that a SIGKILL loses <~100 ms
+    flightrec.configure(
+        os.environ[flightrec.BLACKBOX_DIR_ENV],
+        process_name="server-0",
+        flush_interval_s=0.05,
+        watchdog_interval_s=60,  # this test induces a crash, not a stall
+    )
+    srv = ShardServer(Sgd(eta=0.1), KeyRange(0, 4096))
+    srv.start()
+    print("ADDR", srv.address, flush=True)
+    # serve until killed (the parent SIGKILLs this process mid-window);
+    # the periodic flusher is what makes the box survive that
+    import time
+
+    while True:
+        time.sleep(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,8 @@ class TestCounterContract:
             "serve_cache_invalidations", "serve_not_modified",
             "serve_shed", "serve_shed_served", "serve_encode_reuse",
             "serve_hot_keys", "coord_ingest_coalesced",
+            # ISSUE 9 blackbox plane: boxes written + watchdog firings
+            "blackbox_dumps", "watchdog_stalls",
         } <= names
         from parameter_server_tpu.utils.metrics import format_cluster_stats
 

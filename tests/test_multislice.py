@@ -685,6 +685,9 @@ class TestChaosSoak:
             timeout=420, fault_kill="server:1@2.0",
             fault_restart_after=0.5, ckpt_dir=str(tmp_path / "sckpt"),
             fault_plan=plan, fault_seed=4242,
+            # ISSUE 9 satellite: the soak runs with the black box armed,
+            # so ANY failure of this drill leaves a postmortem behind
+            blackbox_dir=str(tmp_path / "bb"),
         )
         # completion through the outage: no worker declared dead, every
         # (epoch, file) shard finished, and the attempts ledger balances —
@@ -713,3 +716,12 @@ class TestChaosSoak:
         # this family (>0.85), within the checkpoint-restart tolerance
         assert out["val_auc"] > 0.83, out
         assert out["nnz_w"] > 0
+        # the black boxes survived the drill — including the SIGKILL'd
+        # server's (periodic flush), and the postmortem merges a
+        # cross-process-stitched timeline out of the wreckage
+        from parameter_server_tpu.utils import postmortem as pm_mod
+
+        res = pm_mod.postmortem(str(tmp_path / "bb"))
+        # scheduler + 2 servers + 2 workers (+ the replacement server)
+        assert res["processes"] >= 5, res["report"][:2000]
+        assert res["cross_process_calls"] >= 1, res["report"][:2000]

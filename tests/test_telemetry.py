@@ -245,7 +245,11 @@ class TestTelemetrySnapshotMerge:
 
     def test_snapshot_shape(self):
         s = telemetry_snapshot()
-        assert set(s) == {"counters", "hists", "timers"}
+        # key_heat rides along only once some shard server counted keys
+        # (ISSUE 9), so it is optional in the shape contract
+        assert {"counters", "hists", "timers"} <= set(s) <= {
+            "counters", "hists", "timers", "key_heat"
+        }
         json.dumps(s)  # wire-serializable
 
     def test_format_tables_render(self):
