@@ -28,6 +28,15 @@ invariants PRs 1-4 introduced:
     stale-pragma         a justified pragma that suppresses nothing is
                          itself a finding (suppressions can't outlive
                          the code they excused)
+    spec-conformance     the psmc protocol models' declared ASSUMPTIONS
+                         (analysis/specs/) match the AST-derived code
+                         tables — model and code cannot drift silently
+                         (analysis/conformance.py)
+    model-invariants     the tier-1-bounded model suite itself verifies
+                         clean (exactly-once / rcu / ssp / failover)
+    flightrec-contract   every flightrec.record() event is known to the
+                         postmortem plane, and every stitched/flagged
+                         event name is actually emitted
 
 Suppressions: ``# psl: ignore[<checker>]: <why>`` at the flagged line;
 tree policy in pyproject.toml ``[tool.pslint]``. The runtime complements:
@@ -45,6 +54,11 @@ from __future__ import annotations
 from pathlib import Path
 
 from parameter_server_tpu.analysis.blocking import check_blocking_under_lock
+from parameter_server_tpu.analysis.conformance import (
+    check_model_invariants,
+    check_spec_conformance,
+    derive_code_tables,
+)
 from parameter_server_tpu.analysis.contracts import (
     check_config_contract,
     check_counter_contract,
@@ -62,6 +76,9 @@ from parameter_server_tpu.analysis.core import (
     load_package,
     run_checkers,
 )
+from parameter_server_tpu.analysis.flightreccontract import (
+    check_flightrec_contract,
+)
 from parameter_server_tpu.analysis.lockgraph import (
     build_lock_graph,
     check_lock_order,
@@ -78,12 +95,15 @@ __all__ = [
     "Finding",
     "PackageIndex",
     "PslintConfig",
+    "SEVERITY_WARN_DEFAULT",
     "analyze_package",
     "analyze_sources",
     "build_lock_graph",
     "config_key_usage",
     "counter_inventory",
+    "derive_code_tables",
     "load_package",
+    "severity_of",
 ]
 
 #: name -> checker; the registry every later PR extends
@@ -102,7 +122,27 @@ CHECKERS: dict[str, Checker] = {
     # special-cased by run_checkers: audits suppression USAGE, so it
     # runs off the other enabled checkers' raw findings
     "stale-pragma": check_stale_pragma,
+    # ISSUE 10 (psmc): spec<->code conformance + the bounded model
+    # suite, and the flightrec/postmortem event-table contract
+    "spec-conformance": check_spec_conformance,
+    "model-invariants": check_model_invariants,
+    "flightrec-contract": check_flightrec_contract,
 }
+
+#: checkers whose findings default to "warn" severity (exit 2, not 1)
+#: when nothing in ``[tool.pslint] warn`` says otherwise; everything
+#: else is "error". Severity tiers exist so CI can gate hard on errors
+#: while new analyses phase in as warnings.
+SEVERITY_WARN_DEFAULT: frozenset[str] = frozenset()
+
+
+def severity_of(checker: str, config: PslintConfig | None = None) -> str:
+    """"error" or "warn" for one checker, honoring ``[tool.pslint]
+    warn`` (the config list EXTENDS the built-in default set)."""
+    warn = set(SEVERITY_WARN_DEFAULT)
+    if config is not None:
+        warn |= set(config.warn)
+    return "warn" if checker in warn else "error"
 
 
 def _default_config(root: Path) -> PslintConfig:

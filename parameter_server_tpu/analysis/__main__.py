@@ -23,11 +23,17 @@ import sys
 from collections import Counter
 from pathlib import Path
 
-from parameter_server_tpu.analysis import CHECKERS, PACKAGE_ROOT, analyze_package
-from parameter_server_tpu.analysis.core import Finding
+from parameter_server_tpu.analysis import (
+    CHECKERS,
+    PACKAGE_ROOT,
+    _default_config,
+    analyze_package,
+    severity_of,
+)
+from parameter_server_tpu.analysis.core import Finding, PslintConfig
 
 
-def finding_json(f: Finding) -> dict:
+def finding_json(f: Finding, config: PslintConfig | None = None) -> dict:
     return {
         "checker": f.checker,
         "file": f.path,
@@ -35,6 +41,10 @@ def finding_json(f: Finding) -> dict:
         "message": f.message,
         # the pragma-able id: # psl: ignore[<id>]: <why> on f.line
         "id": f.checker,
+        # error | warn — tiered exit codes: any error gates exit 1,
+        # warn-only runs exit 2, clean exits 0 ([tool.pslint] warn
+        # extends the built-in warn set)
+        "severity": severity_of(f.checker, config),
     }
 
 
@@ -83,8 +93,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument(
         "--baseline", default=None, metavar="FILE",
         help="gate on no NEW findings vs this JSON baseline (missing "
-        "file = empty baseline); combine with --update-baseline to "
-        "(re)record it",
+        "file = empty baseline); matching is LINE-INSENSITIVE — "
+        "entries match on (checker, file, message) as a multiset, so "
+        "edits above a finding never churn the gate but a second "
+        "instance of a baselined finding still fails; combine with "
+        "--update-baseline to (re)record it",
     )
     p.add_argument(
         "--update-baseline", action="store_true",
@@ -99,10 +112,12 @@ def main(argv: list[str] | None = None) -> int:
         if unknown:
             p.error(f"unknown checker(s) {unknown}; known: {sorted(CHECKERS)}")
         checkers = {n: CHECKERS[n] for n in args.checker}
-    findings = analyze_package(args.root, checkers=checkers)
+    config = _default_config(Path(args.root))
+    findings = analyze_package(args.root, checkers=checkers, config=config)
     if args.baseline and args.update_baseline:
         Path(args.baseline).write_text(json.dumps(
-            {"findings": [finding_json(f) for f in findings]}, indent=1,
+            {"findings": [finding_json(f, config) for f in findings]},
+            indent=1,
         ))
         print(
             f"pslint: baseline {args.baseline} updated "
@@ -114,20 +129,156 @@ def main(argv: list[str] | None = None) -> int:
         bp = Path(args.baseline)
         baseline = load_baseline(bp) if bp.exists() else Counter()
         gated = new_vs_baseline(findings, baseline)
+    errors = [
+        f for f in gated if severity_of(f.checker, config) == "error"
+    ]
     if args.json:
-        print(json.dumps([finding_json(f) for f in gated]))
+        print(json.dumps([finding_json(f, config) for f in gated]))
     else:
         for f in gated:
-            print(f.render())
+            sev = severity_of(f.checker, config)
+            print(f"{f.render()} [{sev}]" if sev == "warn" else f.render())
         suffix = (
             f" ({len(gated)} NEW vs baseline {args.baseline})"
             if args.baseline else ""
         )
         print(
-            f"pslint: {len(findings)} finding(s){suffix}, "
+            f"pslint: {len(findings)} finding(s) "
+            f"({len(errors)} error(s), {len(gated) - len(errors)} "
+            f"warning(s) gating){suffix}, "
             f"{len(checkers)} checker(s) over {args.root}"
         )
-    return 1 if gated else 0
+    # tiered exit codes: errors are a hard 1, a warn-only run exits 2
+    # (CI can gate on 1 while new analyses phase in), clean is 0
+    return 1 if errors else (2 if gated else 0)
+
+
+def check_main(argv: list[str] | None = None) -> int:
+    """``cli check`` — psmc, the explicit-state protocol model checker
+    (analysis/model.py over analysis/specs/), plus the spec<->code
+    conformance diff. Exit 0 only when every selected spec model
+    EXHAUSTS its bounded state space with zero invariant/liveness
+    violations AND no model assumption has drifted from the
+    AST-derived code tables; a violation prints its shortest
+    counterexample as a replayable step list."""
+    from parameter_server_tpu.analysis import load_package
+    from parameter_server_tpu.analysis.conformance import conformance_diff
+    from parameter_server_tpu.analysis.model import check
+    from parameter_server_tpu.analysis.specs import SPECS
+
+    p = argparse.ArgumentParser(prog="psmc")
+    p.add_argument(
+        "--spec", action="append", default=None,
+        help="check only this protocol model (repeatable); default: "
+        f"all ({', '.join(SPECS)})",
+    )
+    p.add_argument(
+        "--max-states", type=int, default=200_000,
+        help="BFS state cap; a capped run is reported incomplete and "
+        "fails (verification demands exhaustion of the bounded space)",
+    )
+    p.add_argument(
+        "--probe-seeds", type=int, default=0,
+        help="when the cap is hit, continue with this many seeded "
+        "random walks past the frontier (deterministic bug probing, "
+        "not verification)",
+    )
+    p.add_argument(
+        "--bug", default=None, metavar="KNOB",
+        help="check the named seeded-bug VARIANT instead (requires "
+        "exactly one --spec); exit 0 iff the checker produces a "
+        "counterexample — how the suite's mutation coverage is "
+        "demonstrated by hand",
+    )
+    p.add_argument(
+        "--root", default=str(PACKAGE_ROOT),
+        help="package directory the conformance diff derives code "
+        "tables from",
+    )
+    p.add_argument(
+        "--no-conformance", action="store_true",
+        help="skip the spec<->code conformance diff (models only)",
+    )
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    names = list(SPECS)
+    if args.spec:
+        unknown = sorted(set(args.spec) - set(SPECS))
+        if unknown:
+            p.error(f"unknown spec(s) {unknown}; known: {sorted(SPECS)}")
+        names = list(args.spec)
+    if args.bug is not None and len(names) != 1:
+        p.error("--bug requires exactly one --spec")
+
+    results = []
+    for name in names:
+        mod = SPECS[name]
+        if args.bug is not None:
+            if args.bug not in mod.BUGS:
+                p.error(
+                    f"spec {name!r} has no bug knob {args.bug!r}; "
+                    f"known: {list(mod.BUGS)}"
+                )
+            spec = mod.make(bug=args.bug)
+        else:
+            spec = mod.tier1()
+        results.append(check(
+            spec, max_states=args.max_states,
+            probe_seeds=args.probe_seeds,
+        ))
+
+    drift = []
+    config = _default_config(Path(args.root))
+    if args.bug is None and not args.no_conformance:
+        drift = conformance_diff(load_package(Path(args.root)))
+
+    if args.bug is not None:
+        # mutation-coverage mode: the bug MUST be caught
+        r = results[0]
+        ok = r.violation is not None
+        if args.json:
+            print(json.dumps({"bug": args.bug, "caught": ok,
+                              "result": r.summary()}))
+        elif ok:
+            print(f"psmc: seeded bug {args.bug!r} caught:\n"
+                  + r.violation.render())
+        else:
+            print(f"psmc: seeded bug {args.bug!r} NOT caught "
+                  f"({r.states} states) — the model lost its teeth")
+        return 0 if ok else 1
+
+    ok = all(r.ok and r.complete for r in results) and not drift
+    if args.json:
+        print(json.dumps({
+            "ok": ok,
+            "specs": [r.summary() for r in results],
+            "conformance": [finding_json(f, config) for f in drift],
+        }))
+    else:
+        for r in results:
+            status = (
+                "verified" if r.ok and r.complete
+                else "INCOMPLETE (state cap hit)" if r.ok
+                else "VIOLATION"
+            )
+            print(
+                f"psmc: {r.spec:<14} {r.states:>7} states "
+                f"{r.transitions:>8} transitions depth {r.depth:>3}  "
+                f"{status}"
+            )
+            if r.violation is not None:
+                print(r.violation.render())
+        for f in drift:
+            print(f.render())
+        verdict = "all protocols verified at these bounds" if ok else (
+            "NOT verified — fix the model or the code, together"
+        )
+        print(
+            f"psmc: {len(results)} spec(s), {len(drift)} conformance "
+            f"drift finding(s): {verdict}"
+        )
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,335 @@
+"""Spec <-> code conformance: the psmc models (analysis/specs/) declare
+the ASSUMPTIONS they make about the real package; this module DERIVES
+the matching facts from the AST — through the same call-graph/held-lock
+machinery the PR-5/PR-8 checkers use — and diffs the two, so the model
+and ``parallel/multislice.py``/``control.py``/``ssp.py`` cannot drift
+apart silently. A protocol refactor that invalidates a model assumption
+fails ``cli lint`` (and ``cli check``) at the drifted site, with the
+spec named; the fix is to change the model WITH the code, reviewed
+together.
+
+Derived tables (``derive_code_tables``):
+
+- ``idempotent_cmds``: the reply-cache exemption set at the
+  ledger-owning server's ``RpcServer(...)`` construction (reuses the
+  replycache checker's extraction);
+- ``push_rides_reply_cache``: "push" is served and NOT exempt — its
+  replies must ride the exactly-once reply cache;
+- ``ledger_record_under_apply_lock``: every ``self._record_push(...)``
+  call site runs while holding a lock attribute of the owning class
+  (the ledger record and the state mutation it witnesses are one
+  atomic unit);
+- ``ledger_checked_before_apply``: every method that both records
+  pushes and publishes state reads ``self._applied_push`` (the dedup
+  check) before the publish store;
+- ``publish_sites``: the methods that store ``self._pub`` outside
+  ``__init__`` (the RCU model assumes exactly the ``state`` setter);
+- ``publish_bumps_version``: that setter derives the new version from
+  ``_pub[1] + 1``;
+- ``retire_delegates_to_finish``: ``SSPClock.retire`` rides
+  ``finish(worker, RETIRED)`` — retirement takes the same notify path
+  as progress.
+
+Each table is derived only when its subsystem exists in the analyzed
+tree (snippet indexes exercise single tables), and the checker
+``spec-conformance`` emits one finding per drifted assumption. The
+sibling checker ``model-invariants`` runs the tier-1-bounded model
+suite itself inside lint, so a spec edit that breaks a protocol model
+fails the same gate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any
+
+from parameter_server_tpu.analysis.callgraph import shared_callgraph
+from parameter_server_tpu.analysis.core import (
+    Finding,
+    HeldLockWalker,
+    PackageIndex,
+)
+from parameter_server_tpu.analysis.replycache import (
+    declared_sets,
+    served_cmds,
+)
+
+#: assumption key -> the spec facts are derived FOR (reported on drift)
+_LEDGER_KEYS = (
+    "idempotent_cmds",
+    "push_rides_reply_cache",
+    "ledger_record_under_apply_lock",
+    "ledger_checked_before_apply",
+)
+_RCU_KEYS = ("publish_sites", "publish_bumps_version")
+_SSP_KEYS = ("retire_delegates_to_finish",)
+
+
+def _is_self_attr(node: ast.AST, attr: str | None = None) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and (attr is None or node.attr == attr)
+    )
+
+
+def _find_class(
+    index: PackageIndex, predicate
+) -> tuple[str, ast.ClassDef] | None:
+    for f in index.files:
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ClassDef) and predicate(node):
+                return f.relpath, node
+    return None
+
+
+def _defines_method(cls: ast.ClassDef, name: str) -> bool:
+    return any(
+        isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name == name
+        for n in cls.body
+    )
+
+
+class _LockedCallScan(HeldLockWalker):
+    """Collects whether every ``self.<method>(...)`` call of interest
+    inside one function runs with at least one held lock."""
+
+    def __init__(self, is_lock_expr, method: str):
+        super().__init__(is_lock_expr)
+        self._method = method
+        self.calls: list[bool] = []  # held? per call site
+
+    def on_call(self, node: ast.Call, held) -> None:
+        if _is_self_attr(node.func, self._method):
+            self.calls.append(bool(held))
+
+
+def _ledger_tables(
+    index: PackageIndex, relpath: str, cls: ast.ClassDef
+) -> dict[str, Any]:
+    graph = shared_callgraph(index)
+    out: dict[str, Any] = {}
+    # reply-cache exemptions at this class's RpcServer(...) site
+    idem: set[str] = set()
+    for kw, names, _line in declared_sets(cls):
+        if kw == "idempotent_cmds":
+            idem |= names
+    served = served_cmds(cls)
+    out["idempotent_cmds"] = frozenset(idem)
+    out["push_rides_reply_cache"] = (
+        "push" in served and "push" not in idem
+    )
+
+    def is_lock(expr: ast.AST) -> str | None:
+        if _is_self_attr(expr):
+            return graph.lock_attr_key(cls.name, expr.attr)
+        return None
+
+    held_flags: list[bool] = []
+    before_publish = True
+    for node in cls.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scan = _LockedCallScan(is_lock, "_record_push")
+        scan.walk_function(node)
+        held_flags.extend(scan.calls)
+        # dedup-before-publish: a method that records AND publishes must
+        # read self._applied_push before its first publish store
+        if not scan.calls:
+            continue
+        publish_line = None
+        check_line = None
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if _is_self_attr(t, "state") or _is_self_attr(t, "_pub"):
+                        publish_line = min(
+                            publish_line or sub.lineno, sub.lineno
+                        )
+            if (
+                isinstance(sub, ast.Attribute)
+                and sub.attr == "_applied_push"
+                and isinstance(sub.ctx, ast.Load)
+            ):
+                check_line = min(check_line or sub.lineno, sub.lineno)
+        if publish_line is not None and (
+            check_line is None or check_line > publish_line
+        ):
+            before_publish = False
+    out["ledger_record_under_apply_lock"] = (
+        bool(held_flags) and all(held_flags)
+    )
+    out["ledger_checked_before_apply"] = before_publish and bool(held_flags)
+    return out
+
+
+def _rcu_tables(cls: ast.ClassDef) -> dict[str, Any]:
+    sites: set[str] = set()
+    bump = False
+    for node in cls.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stores_pub = any(
+            isinstance(sub, ast.Assign)
+            and any(_is_self_attr(t, "_pub") for t in sub.targets)
+            for sub in ast.walk(node)
+        )
+        if not stores_pub:
+            continue
+        if node.name != "__init__":
+            sites.add(node.name)
+            # version bump: the new tuple derives from _pub[1] + 1
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.BinOp)
+                    and isinstance(sub.op, ast.Add)
+                    and isinstance(sub.right, ast.Constant)
+                    and sub.right.value == 1
+                    and any(
+                        isinstance(x, ast.Attribute) and x.attr == "_pub"
+                        for x in ast.walk(sub.left)
+                    )
+                ):
+                    bump = True
+    return {
+        "publish_sites": frozenset(sites),
+        "publish_bumps_version": bump,
+    }
+
+
+def _ssp_tables(cls: ast.ClassDef) -> dict[str, Any]:
+    delegates = False
+    for node in cls.body:
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "retire"
+        ):
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and _is_self_attr(sub.func, "finish")
+                    and any(
+                        isinstance(a, ast.Attribute) and a.attr == "RETIRED"
+                        for a in sub.args
+                    )
+                ):
+                    delegates = True
+    return {"retire_delegates_to_finish": delegates}
+
+
+def derive_code_tables(index: PackageIndex) -> dict[str, Any]:
+    """The code-side facts, derived per subsystem PRESENT in the tree
+    (absent subsystems contribute no keys — snippet indexes exercise
+    one table at a time; the real package derives all of them)."""
+    out: dict[str, Any] = {}
+    ledger = _find_class(
+        index, lambda c: _defines_method(c, "_record_push")
+    )
+    if ledger is not None:
+        relpath, cls = ledger
+        out["__ledger_site__"] = (relpath, cls.lineno, cls.name)
+        out.update(_ledger_tables(index, relpath, cls))
+    rcu_cls = _find_class(
+        index,
+        lambda c: any(
+            isinstance(sub, ast.Assign)
+            and any(_is_self_attr(t, "_pub") for t in sub.targets)
+            for sub in ast.walk(c)
+        ),
+    )
+    if rcu_cls is not None:
+        relpath, cls = rcu_cls
+        out["__rcu_site__"] = (relpath, cls.lineno, cls.name)
+        out.update(_rcu_tables(cls))
+    clock = _find_class(
+        index,
+        lambda c: _defines_method(c, "retire")
+        and _defines_method(c, "finish"),
+    )
+    if clock is not None:
+        relpath, cls = clock
+        out["__ssp_site__"] = (relpath, cls.lineno, cls.name)
+        out.update(_ssp_tables(cls))
+    return out
+
+
+def _site_for(key: str, tables: dict[str, Any]) -> tuple[str, int, str]:
+    if key in _RCU_KEYS:
+        return tables["__rcu_site__"]
+    if key in _SSP_KEYS:
+        return tables["__ssp_site__"]
+    return tables["__ledger_site__"]
+
+
+def conformance_diff(index: PackageIndex) -> list[Finding]:
+    """One finding per spec assumption the derived code tables
+    contradict. Empty on the real package — the acceptance bar."""
+    from parameter_server_tpu.analysis.specs import SPECS
+
+    tables = derive_code_tables(index)
+    out: list[Finding] = []
+    for spec_name, mod in SPECS.items():
+        for key, want in mod.ASSUMPTIONS.items():
+            if key not in tables:
+                continue  # subsystem absent from this tree: not judged
+            got = tables[key]
+            if got == want:
+                continue
+            relpath, line, cls_name = _site_for(key, tables)
+            want_s = (
+                "{" + ", ".join(sorted(want)) + "}"
+                if isinstance(want, frozenset) else repr(want)
+            )
+            got_s = (
+                "{" + ", ".join(sorted(got)) + "}"
+                if isinstance(got, frozenset) else repr(got)
+            )
+            out.append(Finding(
+                "spec-conformance", relpath, line,
+                f"spec {spec_name!r} assumes {key} = {want_s} but "
+                f"{cls_name} derives {got_s} — the model and the code "
+                "have drifted; change analysis/specs/ WITH this code "
+                "(reviewed together) or the checked protocol no longer "
+                "describes what ships",
+            ))
+    return out
+
+
+def check_spec_conformance(index: PackageIndex) -> list[Finding]:
+    return conformance_diff(index)
+
+
+def check_model_invariants(index: PackageIndex) -> list[Finding]:
+    """Run the tier-1-bounded model suite inside lint: a spec edit (or
+    bound change) that makes a protocol model violate its invariants —
+    or stop exhausting its bounded space — fails the same gate the
+    code-side checkers do. Skipped for snippet indexes (the models are
+    package facts, not snippet facts)."""
+    if index.get("parallel/multislice.py") is None:
+        return []
+    from parameter_server_tpu.analysis.model import check
+    from parameter_server_tpu.analysis.specs import SPECS
+
+    out: list[Finding] = []
+    for name, mod in sorted(SPECS.items()):
+        res = check(mod.tier1(), max_states=120_000)
+        rel = f"analysis/specs/{mod.__name__.rsplit('.', 1)[-1]}.py"
+        if res.violation is not None:
+            out.append(Finding(
+                "model-invariants", rel, 1,
+                f"spec {name!r} violates its own "
+                f"{res.violation.kind} at tier-1 bounds: "
+                f"{res.violation.message} (trace: "
+                + " -> ".join(res.violation.trace[-6:]) + ")",
+            ))
+        elif not res.complete:
+            out.append(Finding(
+                "model-invariants", rel, 1,
+                f"spec {name!r} no longer exhausts its tier-1 bounds "
+                f"({res.states} states explored, cap hit) — 'verified' "
+                "claims need a complete run; shrink the bounds or raise "
+                "the cap",
+            ))
+    return out

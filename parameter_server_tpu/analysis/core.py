@@ -78,6 +78,10 @@ class PslintConfig:
 
     exclude: list[str] = field(default_factory=list)  # relpath globs
     disable: list[str] = field(default_factory=list)  # checker names
+    #: checkers demoted to "warn" severity (tiered exit codes: errors
+    #: exit 1, warn-only runs exit 2) — how a new analysis phases in
+    #: without invalidating an error-gating CI baseline workflow
+    warn: list[str] = field(default_factory=list)
 
     @classmethod
     def load(cls, pyproject: Path | None) -> "PslintConfig":
@@ -90,6 +94,7 @@ class PslintConfig:
         return cls(
             exclude=list(sec.get("exclude", [])),
             disable=list(sec.get("disable", [])),
+            warn=list(sec.get("warn", [])),
         )
 
 

@@ -306,6 +306,184 @@ def maybe_install_from_env() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# seed corpus + budgeted search (``cli explore``)
+# ---------------------------------------------------------------------------
+
+#: committed corpus of schedule seeds that once FAILED a test: the
+#: explorer-armed tier-1 run replays them forever (a fixed bug's
+#: breaking interleaving becomes its regression test), and ``cli
+#: explore`` appends new ones
+CORPUS_SCHEMA = "pssched/1"
+
+
+def load_corpus(path: str) -> dict[str, list[int]]:
+    """test node id -> failing seeds. Missing/foreign files read as
+    empty — exploration must bootstrap from nothing."""
+    import json
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if doc.get("schema") != CORPUS_SCHEMA:
+        return {}
+    return {
+        str(t): sorted({int(s) for s in seeds})
+        for t, seeds in (doc.get("tests") or {}).items()
+    }
+
+
+def corpus_seeds(path: str, test: str) -> list[int]:
+    """The committed failing seeds for one test node id (the set the
+    explorer-armed tier-1 run replays on top of its fixed seed)."""
+    return load_corpus(path).get(test, [])
+
+
+def record_failing_seeds(
+    path: str, test: str, seeds: list[int]
+) -> dict[str, list[int]]:
+    """Merge newly found failing seeds into the corpus file (created if
+    missing; seeds dedup'd and sorted so the diff is reviewable). A file
+    that EXISTS but doesn't parse as this schema (torn merge, future
+    build) refuses the write — load_corpus reads such files as empty,
+    and silently rewriting would destroy every committed seed."""
+    import json
+
+    corpus = load_corpus(path)
+    if not corpus and os.path.exists(path) and os.path.getsize(path):
+        try:
+            with open(path) as f:
+                ours = json.load(f).get("schema") == CORPUS_SCHEMA
+        except (OSError, ValueError):
+            ours = False
+        if not ours:
+            raise RuntimeError(
+                f"corpus {path} exists but is not a {CORPUS_SCHEMA} "
+                "file (torn write? newer schema?) — refusing to "
+                "overwrite it; fix or remove the file first"
+            )
+    corpus[test] = sorted(set(corpus.get(test, [])) | set(seeds))
+    # atomic tmp+rename (the flightrec dump idiom): a write interrupted
+    # mid-dump must never leave a torn corpus the tier-1 replay would
+    # read as empty
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({
+            "schema": CORPUS_SCHEMA,
+            "comment": (
+                "schedule seeds that once failed a test under the "
+                "PS_SCHED interleaving explorer; cli explore appends, "
+                "the explorer-armed tier-1 run replays. Replay one by "
+                "hand: PS_SCHED=<seed> python -m pytest <test>"
+            ),
+            "tests": {t: corpus[t] for t in sorted(corpus)},
+        }, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    return corpus
+
+
+class SearchError(RuntimeError):
+    """The search INFRASTRUCTURE broke mid-budget (pytest could not
+    run — collection/usage/internal error), as opposed to a seed
+    failing the test. Carries the failing seeds found before the break
+    so the caller can still record/report a long search's finds."""
+
+    def __init__(self, seed: int, failing: list[int], cause: Exception):
+        super().__init__(f"seed {seed}: {cause}")
+        self.seed = seed
+        self.failing = list(failing)
+
+
+def search_seeds(
+    test: str,
+    budget: int,
+    start_seed: int = 1,
+    runner=None,
+    on_result=None,
+    timeout_s: float = 120.0,
+) -> list[int]:
+    """Budgeted schedule-seed search: run ``test`` under
+    ``PS_SCHED=<seed>`` for seeds ``start_seed .. start_seed+budget-1``
+    and return the seeds that FAILED it (the interleavings worth
+    keeping). ``runner(seed) -> bool`` (True = test passed) defaults to
+    a pytest subprocess per seed — a fresh interpreter per seed is what
+    makes the arming honest (the explorer wraps construction, so it
+    must be armed before the package imports). A seed that WEDGES the
+    test past ``timeout_s`` counts as failing: a deadlock interleaving
+    is the search's most valuable find, not a reason to hang it. A
+    runner that RAISES aborts the search with :class:`SearchError`
+    carrying the finds so far — an hours-long budget must not lose its
+    results to one transient infra hiccup."""
+    if runner is None:
+        runner = _pytest_runner(test, timeout_s=timeout_s)
+    failing: list[int] = []
+    for seed in range(start_seed, start_seed + budget):
+        try:
+            passed = bool(runner(seed))
+        except Exception as e:
+            raise SearchError(seed, failing, e) from e
+        if not passed:
+            failing.append(seed)
+        if on_result is not None:
+            on_result(seed, passed)
+    return failing
+
+
+def _pytest_runner(test: str, timeout_s: float = 120.0):
+    import signal
+    import subprocess
+    import sys as _sys
+
+    # a relative node id ("tests/test_x.py::T::t") only collects from
+    # the repo root — anchor the subprocess there when the file part
+    # isn't visible from the caller's cwd, so `cli explore` works from
+    # any directory instead of recording collection errors as "finds"
+    cwd = None
+    file_part = test.split("::", 1)[0]
+    if not os.path.exists(file_part):
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        if os.path.exists(os.path.join(repo_root, file_part)):
+            cwd = repo_root
+
+    def run(seed: int) -> bool:
+        env = dict(os.environ, **{ENV_VAR: str(seed)})
+        # own session so a timed-out child's whole process GROUP dies —
+        # the test may have launch_local'd server processes a bare
+        # kill() of pytest would orphan
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "pytest", test, "-x", "-q",
+             "--no-header", "-p", "no:cacheprovider"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, start_new_session=True, cwd=cwd,
+        )
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.communicate()
+            return False  # a wedged interleaving IS a failing seed
+        # pytest: 0 = passed, 1 = tests ran and failed. Anything else
+        # (collection/usage/internal error, no tests collected) means
+        # the SEARCH is broken, not the interleaving — recording such a
+        # seed would poison the corpus tier-1 replays
+        if proc.returncode not in (0, 1):
+            tail = "\n".join((out + err).strip().splitlines()[-5:])
+            raise RuntimeError(
+                f"explore: pytest could not run {test!r} "
+                f"(exit {proc.returncode}):\n{tail}"
+            )
+        return proc.returncode == 0
+
+    return run
+
+
+# ---------------------------------------------------------------------------
 # strict mode: deterministic PCT scheduling of crafted scenarios
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,45 @@
+"""Spec inventory for psmc (analysis/model.py): small executable models
+of the repo's core protocols, each with (a) a correct configuration the
+checker must verify clean at tier-1 bounds, (b) seeded-bug knobs the
+checker must CATCH (mutation coverage for the checker itself), and
+(c) ``ASSUMPTIONS`` — the facts about the real code the model's
+correctness rests on, diffed against AST-derived tables by
+``analysis/conformance.py`` so spec and code cannot drift silently.
+
+    exactly-once   pipelined client window x reconnect-resend x reply
+                   cache x durable ledger x server restart; invariant:
+                   acked => applied exactly once
+    rcu            versioned RCU publish/read: per-life monotonic
+                   versions, no torn (state, version) pair observable,
+                   per-life nonce so a rolled-back restart can never
+                   falsely validate a cached version
+    ssp            SSP clock bounded staleness under worker death,
+                   retire and reassignment; liveness: the gate never
+                   wedges live workers
+    failover       direction #1's chain-replication failover, stated as
+                   checked transitions BEFORE any production code:
+                   primary dies mid-window, successor promotes from the
+                   replayed apply stream, clients re-point and resend
+
+Each module exports ``make(bug=None, **bounds) -> Spec``, ``BUGS``
+(the seeded-bug knob names) and ``ASSUMPTIONS``; ``tier1()`` returns
+the bounded instance ``cli check`` verifies in CI.
+"""
+
+from __future__ import annotations
+
+from parameter_server_tpu.analysis.specs import (
+    exactly_once,
+    failover,
+    rcu,
+    sspclock,
+)
+
+#: name -> spec module (make/tier1/BUGS/ASSUMPTIONS); the registry
+#: cli check, the model-invariants checker and the tests all iterate
+SPECS = {
+    "exactly-once": exactly_once,
+    "rcu": rcu,
+    "ssp": sspclock,
+    "failover": failover,
+}

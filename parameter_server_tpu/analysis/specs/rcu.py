@@ -1,0 +1,217 @@
+"""Spec: the versioned RCU publish/read protocol of
+``ShardServer._pub`` — one reference swap publishes the (state,
+version) pair, readers capture the WHOLE pair in one load, versions are
+strictly monotonic within a server life, and a per-life nonce keeps a
+version cached from a PREVIOUS life (whose tail applies a checkpoint
+restart rolled back) from ever falsely validating an ``if_newer``
+revalidation.
+
+The model's ground truth for "which rows" is ``(life, applies)``: the
+content a snapshot holds is determined by which life produced it and
+how many applies it folded — a restart that rolls back and re-applies
+produces DIFFERENT content at the same per-life counter (re-sent
+pushes coalesce into different batches), which is exactly why a bare
+counter can falsely validate. A reader may capture the published pair,
+cache it, and later revalidate: version equality serves the CACHED
+rows (the serving plane's ``not_modified`` path).
+
+Invariants: (a) every publish strictly increases the version within
+its life; (b) a version-equality revalidation serves rows identical to
+the server's current snapshot (the false-validate check — this is what
+tears and nonce-less rollbacks break).
+
+Seeded bugs (``BUGS``):
+
+    torn-publish   version and state swap in two steps (version
+                   first) — a capture between them pairs OLD rows with
+                   the NEW version; once the state lands, revalidation
+                   matches versions and serves the stale rows
+    no-nonce       versions restart from the checkpointed counter in a
+                   new life without a namespace — a cached pre-crash
+                   version collides with a post-restart version whose
+                   rows differ (the rolled-back tail re-applied in
+                   different batches)
+    no-bump        a publish path skips the version bump — two
+                   different snapshots share a version (monotonicity)
+
+ASSUMPTIONS (diffed by analysis/conformance.py): the only method that
+stores ``self._pub`` outside ``__init__`` is the ``state`` setter (the
+single publish site), and that setter bumps the version by one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Hashable
+
+from parameter_server_tpu.analysis.model import Spec
+
+BUGS = ("torn-publish", "no-nonce", "no-bump")
+
+ASSUMPTIONS = {
+    # the only _pub store sites outside __init__: the property setter
+    "publish_sites": frozenset({"state"}),
+    "publish_bumps_version": True,
+}
+
+Rows = tuple[int, int]  # (life, applies): the content identity
+Ver = tuple[int, int]  # (nonce, counter); nonce 0 under no-nonce
+
+
+@dataclass(frozen=True)
+class _S:
+    life: int
+    counter: int  # per-life publish counter (the version's low bits)
+    applies: int  # applies folded this life (content ground truth)
+    pub_rows: Rows  # published state slot
+    pub_ver: Ver  # published version slot
+    torn: bool  # between the two stores of a torn publish
+    pend_rows: Rows | None  # the state the torn publish will land
+    applies_left: int
+    restarts_left: int
+    reads_left: int  # bounded captures: quiescence must be reachable
+    ckpt: tuple[int, int] | None  # (counter, applies) checkpointed
+    cache: tuple[Ver, Rows] | None  # reader's cached (version, rows)
+    stale_served: bool  # a matching revalidation served foreign rows
+    nonmono: bool  # a publish failed to increase within its life
+
+
+class RcuSpec(Spec):
+    name = "rcu"
+
+    def __init__(
+        self,
+        applies: int = 3,
+        restarts: int = 1,
+        reads: int = 3,
+        bug: str | None = None,
+    ):
+        if bug is not None and bug not in BUGS:
+            raise ValueError(f"unknown bug {bug!r}; known: {BUGS}")
+        self.applies = applies
+        self.restarts = restarts
+        # reader capture budget: without one a reader action is enabled
+        # in EVERY state, no quiescent state ever exists, and the
+        # liveness hook is vacuously unreachable
+        self.reads = reads
+        self.bug = bug
+
+    def _ver(self, life: int, counter: int) -> Ver:
+        # the per-life nonce: real versions namespace a 40-bit counter
+        # by fresh random high bits per life; the model uses the life id
+        # itself. The no-nonce bug drops the namespace.
+        return (0, counter) if self.bug == "no-nonce" else (life, counter)
+
+    def init_states(self) -> list[Hashable]:
+        return [_S(
+            life=1, counter=1, applies=0, pub_rows=(1, 0),
+            pub_ver=self._ver(1, 1), torn=False, pend_rows=None,
+            applies_left=self.applies, restarts_left=self.restarts,
+            reads_left=self.reads, ckpt=None, cache=None,
+            stale_served=False, nonmono=False,
+        )]
+
+    def actions(self, s: _S) -> list[tuple[str, Hashable]]:
+        out: list[tuple[str, Hashable]] = []
+        if s.torn:
+            # second store of the torn publish: the state lands
+            out.append((
+                "writer: publish step 2 (store state)",
+                replace(s, torn=False, pub_rows=s.pend_rows,
+                        pend_rows=None),
+            ))
+        elif s.applies_left > 0:
+            nc = s.counter if self.bug == "no-bump" else s.counter + 1
+            na = s.applies + 1
+            nv = self._ver(s.life, nc)
+            mono_broke = s.nonmono or nc <= s.counter
+            base = replace(
+                s, applies_left=s.applies_left - 1, applies=na,
+                counter=nc, nonmono=mono_broke,
+            )
+            if self.bug == "torn-publish":
+                # version stored first, state later — the window where
+                # a capture pairs OLD rows with the NEW version (what
+                # the one-tuple swap exists to make impossible)
+                out.append((
+                    "writer: publish step 1 (store version)",
+                    replace(base, pub_ver=nv, torn=True,
+                            pend_rows=(s.life, na)),
+                ))
+            else:
+                out.append((
+                    "writer: publish (one tuple swap)",
+                    replace(base, pub_rows=(s.life, na), pub_ver=nv),
+                ))
+        if s.ckpt is None and not s.torn and s.restarts_left > 0:
+            out.append((
+                "server: checkpoint (state + version counter)",
+                replace(s, ckpt=(s.counter, s.applies)),
+            ))
+        if s.cache is None:
+            if s.reads_left > 0:
+                out.append((
+                    "reader: capture published pair + cache",
+                    replace(s, cache=(s.pub_ver, s.pub_rows),
+                            reads_left=s.reads_left - 1),
+                ))
+        else:
+            ver, rows = s.cache
+            if ver == s.pub_ver:
+                out.append((
+                    "reader: revalidate if_newer -> not_modified "
+                    "(serve cached rows)",
+                    replace(s, cache=None,
+                            stale_served=s.stale_served
+                            or rows != s.pub_rows),
+                ))
+            else:
+                out.append((
+                    "reader: revalidate if_newer -> version moved, "
+                    "refresh rows",
+                    replace(s, cache=None),
+                ))
+        if s.restarts_left > 0 and s.ckpt is not None and not s.torn:
+            ck_counter, ck_applies = s.ckpt
+            nl = s.life + 1
+            rolled_back = s.applies - ck_applies
+            out.append((
+                "server: crash + restart from checkpoint (tail applies "
+                "rolled back; clients will resend them)",
+                replace(
+                    s, life=nl, counter=ck_counter, applies=ck_applies,
+                    pub_rows=(nl, ck_applies),
+                    pub_ver=self._ver(nl, ck_counter),
+                    restarts_left=s.restarts_left - 1, ckpt=None,
+                    applies_left=s.applies_left + rolled_back,
+                ),
+            ))
+        return out
+
+    def invariant(self, s: _S) -> str | None:
+        if s.stale_served:
+            return (
+                "a version-equality revalidation served rows that are "
+                "not the current snapshot — a cached version falsely "
+                "validated (torn publish, or a rollback re-used a "
+                "version without a life nonce)"
+            )
+        if s.nonmono:
+            return (
+                "a publish did not increase the version within its "
+                "life — two different snapshots share a version"
+            )
+        return None
+
+    def liveness(self, s: _S) -> str | None:
+        if s.applies_left > 0 or s.torn:
+            return "writer wedged with applies outstanding"
+        return None
+
+
+def make(bug: str | None = None, **bounds) -> RcuSpec:
+    return RcuSpec(bug=bug, **bounds)
+
+
+def tier1() -> RcuSpec:
+    return RcuSpec(applies=3, restarts=1, reads=3)

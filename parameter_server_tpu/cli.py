@@ -190,11 +190,88 @@ def _build_parser() -> argparse.ArgumentParser:
     li.add_argument(
         "--baseline", default=None, metavar="FILE",
         help="gate on no NEW findings vs this JSON baseline (CI mode: "
-        "pre-existing debt stays visible but frozen)",
+        "pre-existing debt stays visible but frozen). Matching is "
+        "LINE-INSENSITIVE — entries match on (checker, file, message) "
+        "as a multiset, so edits above a finding never churn the gate "
+        "but a second instance of a baselined finding still fails",
     )
     li.add_argument(
         "--update-baseline", action="store_true",
         help="rewrite --baseline from the current findings",
+    )
+
+    ck = sub.add_parser(
+        "check",
+        help="run psmc — the explicit-state protocol model checker "
+        "(analysis/model.py over analysis/specs/: exactly-once pushes, "
+        "RCU publish/read, SSP clock, chain-replication failover) plus "
+        "the spec<->code conformance diff; exits nonzero unless every "
+        "model exhausts its bounded state space violation-free AND no "
+        "spec assumption has drifted from the code",
+    )
+    ck.add_argument(
+        "--spec", action="append", default=None,
+        help="check only this protocol model (repeatable)",
+    )
+    ck.add_argument(
+        "--max-states", type=int, default=200_000,
+        help="BFS state cap (capped runs fail: verification demands "
+        "exhausting the bounded space)",
+    )
+    ck.add_argument(
+        "--probe-seeds", type=int, default=0,
+        help="seeded random walks past a hit cap (bug probing, not "
+        "verification)",
+    )
+    ck.add_argument(
+        "--bug", default=None, metavar="KNOB",
+        help="check the named seeded-bug variant of one --spec; exit 0 "
+        "iff the checker catches it with a counterexample",
+    )
+    ck.add_argument(
+        "--no-conformance", action="store_true",
+        help="skip the spec<->code conformance diff (models only)",
+    )
+    ck.add_argument("--json", action="store_true")
+
+    ex = sub.add_parser(
+        "explore",
+        help="budgeted schedule-seed search (analysis/explorer.py): run "
+        "a test under PS_SCHED=<seed> for N seeds, persist failing "
+        "seeds to the committed corpus, and print the exact replay "
+        "line — how an interleaving bug becomes a regression test",
+    )
+    ex.add_argument(
+        "test",
+        help="pytest node id to explore (e.g. tests/test_serving.py::"
+        "TestServingChaosCoherence::"
+        "test_read_your_writes_and_exactly_once_under_chaos)",
+    )
+    ex.add_argument(
+        "--budget", type=int, default=20,
+        help="seeds to try (one fresh pytest process per seed)",
+    )
+    ex.add_argument(
+        "--start-seed", type=int, default=1,
+        help="first seed of the contiguous budget window",
+    )
+    ex.add_argument(
+        "--corpus", default=None, metavar="FILE",
+        help="corpus file failing seeds are merged into (the "
+        "explorer-armed tier-1 run replays every seed recorded here); "
+        "default: the repo's committed tests/sched_corpus.json, "
+        "resolved next to the package so any CWD records to the file "
+        "tier-1 actually replays",
+    )
+    ex.add_argument(
+        "--timeout", type=float, default=120.0, metavar="S",
+        help="per-seed budget: a seed that wedges the test past this "
+        "counts as FAILING (a deadlock interleaving is the find, not "
+        "a reason to hang the search)",
+    )
+    ex.add_argument(
+        "--no-record", action="store_true",
+        help="print failing seeds without touching the corpus file",
     )
     return p
 
@@ -614,6 +691,84 @@ def main(argv: list[str] | None = None) -> int:
         if args.update_baseline:
             lint_argv.append("--update-baseline")
         return lint_main(lint_argv)
+    if args.cmd == "check":
+        # no config file: the model checker verifies protocol SPECS and
+        # their conformance to the installed package source
+        from parameter_server_tpu.analysis.__main__ import check_main
+
+        check_argv: list[str] = []
+        for s in args.spec or ():
+            check_argv += ["--spec", s]
+        check_argv += ["--max-states", str(args.max_states)]
+        if args.probe_seeds:
+            check_argv += ["--probe-seeds", str(args.probe_seeds)]
+        if args.bug:
+            check_argv += ["--bug", args.bug]
+        if args.no_conformance:
+            check_argv.append("--no-conformance")
+        if args.json:
+            check_argv.append("--json")
+        return check_main(check_argv)
+    if args.cmd == "explore":
+        from pathlib import Path
+
+        from parameter_server_tpu.analysis import explorer
+
+        repo_root = Path(__file__).resolve().parent.parent
+        corpus = args.corpus or str(
+            repo_root / "tests" / "sched_corpus.json"
+        )
+        # the corpus keys on the node id STRING and the explorer-armed
+        # tier-1 run looks seeds up by the canonical repo-relative
+        # spelling — normalize absolute/cwd-relative paths to it, or a
+        # recorded seed would never be replayed
+        file_part, sep, rest = args.test.partition("::")
+        fp = Path(file_part)
+        if fp.exists():
+            try:
+                canon = fp.resolve().relative_to(repo_root).as_posix()
+            except ValueError:
+                canon = file_part  # outside the repo: keep as typed
+            if canon != file_part:
+                args.test = canon + sep + rest
+                print(f"explore: node id normalized to {args.test}")
+
+        def _note(seed: int, passed: bool) -> None:
+            print(
+                f"explore: seed {seed} "
+                + ("passed" if passed else "FAILED — replayable")
+            )
+
+        search_err: Exception | None = None
+        try:
+            failing = explorer.search_seeds(
+                args.test, budget=args.budget,
+                start_seed=args.start_seed,
+                on_result=_note, timeout_s=args.timeout,
+            )
+        except explorer.SearchError as e:
+            # record/report what the budget found BEFORE surfacing the
+            # infra break — a long search must not lose its finds
+            failing, search_err = e.failing, e
+        if failing and not args.no_record:
+            explorer.record_failing_seeds(corpus, args.test, failing)
+            print(f"explore: {len(failing)} failing seed(s) recorded "
+                  f"in {corpus}")
+        for seed in failing:
+            print(f"  replay: PS_SCHED={seed} python -m pytest "
+                  f"{args.test}")
+        print(
+            f"explore: {len(failing)}/{args.budget} seed(s) broke "
+            f"{args.test}"
+        )
+        if search_err is not None:
+            print(f"explore: search aborted — {search_err}")
+            return 1
+        # always 0: finding a failing seed is the SUCCESSFUL outcome of
+        # an exploration budget, and the recorded corpus (replayed by
+        # the explorer-armed tier-1 run) is the durable gate — CI gates
+        # on that replay, not on this search's exit code
+        return 0
     if args.cmd == "stats":
         # no config file: stats only needs a live coordinator address
         print(json.dumps(run_stats(args), default=float))

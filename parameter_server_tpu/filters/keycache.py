@@ -46,7 +46,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from parameter_server_tpu.utils.metrics import wire_counters
+from parameter_server_tpu.utils.metrics import race_track, wire_counters
 
 
 class CacheEntry:
@@ -101,6 +101,11 @@ class ClientKeyCache:
         # concurrent push would re-install pre-push rows and this
         # frontend would read its own write stale.
         self._gen = 0
+        # lockset race witness (PS_RACE_WITNESS=1): the generation is
+        # read by every pull path and bumped by every push invalidation
+        # across a frontend's threads — all under _lock, or the
+        # read-your-writes reasoning above is fiction
+        race_track(self, ("_gen",), "ClientKeyCache")
 
     def __len__(self) -> int:
         with self._lock:

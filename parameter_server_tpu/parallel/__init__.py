@@ -32,6 +32,18 @@ if _os.environ.get("PS_SCHED", "") not in ("", "0"):
 
     _explorer.maybe_install_from_env()
 
+# Eraser-style lockset race witness (analysis/racewitness.py):
+# PS_RACE_WITNESS=1 tracks each thread's held locks and checks every
+# access to REGISTERED shared objects (residual buffers, encode-cache
+# budget, push ledger, heat sketch, key-cache generation — see
+# metrics.race_track call sites) for an empty common lockset on
+# conflicting pairs. Reports collect in racewitness.reports(); armed
+# runs finish and then assert none. Composes over witness/explorer.
+if _os.environ.get("PS_RACE_WITNESS", "") not in ("", "0"):
+    from parameter_server_tpu.analysis import racewitness as _racewitness
+
+    _racewitness.maybe_install_from_env()
+
 from parameter_server_tpu.parallel import runtime  # noqa: F401
 from parameter_server_tpu.parallel.mesh import make_mesh  # noqa: F401
 from parameter_server_tpu.parallel.runtime import Runtime  # noqa: F401

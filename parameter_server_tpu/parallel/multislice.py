@@ -89,6 +89,7 @@ from parameter_server_tpu.utils.keyrange import KeyRange
 from parameter_server_tpu.utils.metrics import (
     key_heat,
     observe_scalar,
+    race_track,
     telemetry_snapshot,
     wire_counters,
 )
@@ -332,6 +333,15 @@ class ShardServer:
         # workers, advertise a routable hostname via the coordinator KV
         _, bound_port = self.server.address.rsplit(":", 1)
         self.address = f"{advertise_host or host}:{bound_port}"
+        # lockset race witness (PS_RACE_WITNESS=1): the encode-cache
+        # byte budget mutates under _enc_lock and the durable ledger
+        # reference only inside _lock's apply/checkpoint critical
+        # sections — the two pieces of serving/apply state a refactor
+        # is most likely to touch lock-free by accident
+        race_track(
+            self, ("_enc_bytes", "_applied_push"),
+            f"ShardServer:{self.address}",
+        )
 
     # push-ledger bounds: wider than the reply cache's — entries are tiny
     # (short strings) and must cover a restart window, not just the last
@@ -1305,6 +1315,15 @@ class ServerHandle:
             from parameter_server_tpu.filters.fixed_point import FixedPointCodec
 
             self._codec = FixedPointCodec(num_bytes=self._codec_bytes)
+        # lockset race witness (PS_RACE_WITNESS=1): the error-feedback
+        # residual state is shared between the worker loop and the
+        # recovery/reader threads — every access must hold _res_lock or
+        # the exactly-once folding guarantee is a race away from double
+        # counting
+        race_track(
+            self, ("_residual", "_res_map", "_res_vdim"),
+            f"ServerHandle:{rank}:w{worker}",
+        )
 
     def _keyed_call(
         self, cmd: str, keys: np.ndarray, arrays: Arrays,
@@ -1960,11 +1979,16 @@ def _export_witness_env(child_env: dict) -> None:
     by an explicit ``witness.install()`` (the tier-1 conftest), which an
     env copy alone would silently fail to propagate. Children arm at
     package import (parallel/__init__), so every lock a spawned node
-    constructs is order-checked too."""
-    from parameter_server_tpu.analysis import witness
+    constructs is order-checked too. The lockset race witness rides the
+    same rule: an armed parent spawns armed children, so the
+    registered shared objects of every node in a launch_local cluster
+    are lockset-checked."""
+    from parameter_server_tpu.analysis import racewitness, witness
 
     if witness.installed():
         child_env[witness.ENV_VAR] = "1"
+    if racewitness.installed():
+        child_env[racewitness.ENV_VAR] = "1"
 
 
 class _RemoteBeatSink:

@@ -11,6 +11,7 @@ workers contribute dicts, process 0 prints the table and appends JSONL.
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 from pathlib import Path
@@ -91,6 +92,21 @@ class CounterSet:
 
 #: process-global wire/recovery counters (see CounterSet docstring)
 wire_counters = CounterSet()
+
+
+def race_track(obj, fields: tuple[str, ...], name: str = "") -> None:
+    """Register one shared object's fields with the Eraser-style lockset
+    race witness (analysis/racewitness.py) IF it is armed
+    (``PS_RACE_WITNESS=1`` or an explicit ``install()``). Resolved
+    through ``sys.modules`` so production code never imports the
+    analysis package: disarmed cost is one dict lookup at CONSTRUCTION
+    time and zero per attribute access. The owning constructors are the
+    registration sites — an instance built before arming keeps raw
+    attributes (its locks are raw too; observing it would report
+    phantom races)."""
+    rw = sys.modules.get("parameter_server_tpu.analysis.racewitness")
+    if rw is not None and rw.installed():
+        rw.track(obj, fields, name)
 
 
 #: log2 latency buckets: bucket i covers [2^(i-1), 2^i) microseconds
@@ -318,6 +334,10 @@ class KeyHeatSketch:
         self._n = 0
         self._hot: dict[int, int] = {}  # candidate key -> last estimate
         self._lock = threading.Lock()
+        # lockset race witness (PS_RACE_WITNESS=1): the sketch is fed
+        # from server conn threads and drained by heartbeat snapshots —
+        # every _t/_n/_hot access must hold _lock
+        race_track(self, ("_t", "_n", "_hot"), "KeyHeatSketch")
 
     def _rows(self, keys: np.ndarray) -> np.ndarray:
         from parameter_server_tpu.utils.hashing import splitmix64

@@ -35,6 +35,57 @@ from parameter_server_tpu.utils.metrics import heat_top, merge_heat_snapshots
 #: dump filename prefix (see flightrec.dump)
 _PREFIX = "blackbox-"
 
+#: the declared PASS-THROUGH inventory: flight-recorder events this
+#: plane knows about but interprets only as timeline context — they are
+#: stitched by (cid, seq) when they carry one (rpc.issue/rpc.out) and
+#: rendered on the merged timeline, but no anomaly detector keys off
+#: them. The pslint ``flightrec-contract`` checker diffs this set plus
+#: the detectors' literal etype comparisons against every
+#: ``flightrec.record()`` call site package-wide, BOTH ways: an emitted
+#: event missing here (and from every detector) is wreckage nobody will
+#: interpret; a name listed here that nobody emits is rename drift.
+#: Growing this set is a deliberate, reviewed act.
+_CONTEXT_EVENTS = frozenset({
+    "apply.begin",       # multislice: batch entered the apply engine
+    "coord.dead_worker", # coordinator sweep promoted a dead worker
+    "heartbeat.beat",    # reporter liveness tick
+    "rpc.conn_died",     # wire: connection death observed
+    "rpc.issue",         # client issue side of the (cid, seq) stitch
+    "rpc.out",           # frame left the process
+    "signal",            # fatal-signal crash hook fired
+    "ssp.finish",        # SSP clock movement
+    "ssp.retire",        # SSP retirement (dead/reassigned worker)
+    "ssp.wait",          # SSP gate blocked a worker (blocked ms)
+    "step.dispatch",     # trainer step anatomy
+    "step.retire",
+    "thread.exception",  # threading.excepthook crash hook fired
+    "watchdog.stall",    # stall firing (the dump's stalls list is the
+                         # detector's source; the event is context)
+})
+
+#: the detectors'/stitchers' etype literals, repeated as one set so the
+#: RUNTIME unknown-event check below can complement _CONTEXT_EVENTS
+#: (the flightrec-contract checker derives its "known" side from the
+#: actual comparisons in this file, not from this convenience set)
+_DETECTOR_EVENTS = frozenset({
+    "rpc.in", "rpc.reply", "apply.commit", "apply.replay", "rcu.publish",
+    "rpc.heal.begin", "rpc.healed", "rpc.heal.failed", "serve.shed",
+})
+
+
+def unknown_events(timeline: list[dict[str, Any]]) -> dict[str, int]:
+    """etype -> count for merged-timeline events NEITHER a detector nor
+    the pass-through inventory knows. Nonempty means the dumps came from
+    a build newer than this postmortem code (or flightrec-contract was
+    bypassed) — the events still render on the timeline, but nothing
+    interprets them."""
+    seen: dict[str, int] = {}
+    for ev in timeline:
+        et = ev["etype"]
+        if et not in _CONTEXT_EVENTS and et not in _DETECTOR_EVENTS:
+            seen[et] = seen.get(et, 0) + 1
+    return seen
+
 
 def load_dumps(box_dir: str) -> list[dict[str, Any]]:
     """Every parseable ``blackbox-*.json`` in the dir (skipping torn or
@@ -360,6 +411,15 @@ def render_report(
             lines.append(f"  [{kind}] {rest}")
     else:
         lines.append("no anomalies flagged")
+    unknown = unknown_events(timeline)
+    if unknown:
+        lines.append("")
+        lines.append(
+            f"UNINTERPRETED event type(s) ({len(unknown)}) — dumps from "
+            "a newer build than this postmortem code?"
+        )
+        for et, n in sorted(unknown.items()):
+            lines.append(f"  {et} x{n}")
     heat = merged_heat(dumps)
     if heat:
         lines.append("")
@@ -403,6 +463,7 @@ def postmortem(
         "stitched_calls": len(calls),
         "cross_process_calls": len(cross),
         "anomalies": anomalies,
+        "unknown_events": unknown_events(timeline),
         "crash_sidecars": crash_sidecars(box_dir) if dumps else [],
         "report": render_report(dumps, timeline, anomalies, tail=tail),
     }

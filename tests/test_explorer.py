@@ -179,24 +179,45 @@ class TestPerturbMode:
             explorer.uninstall()
 
 
+#: the node id the committed seed corpus keys the serving coherence
+#: test under (cli explore records against the same id)
+_COHERENCE_NODE = (
+    "tests/test_serving.py::TestServingChaosCoherence::"
+    "test_read_your_writes_and_exactly_once_under_chaos"
+)
+
+
 class TestExplorerArmedServing:
     def test_serving_chaos_coherence_survives_forced_interleavings(self):
         """The armed acceptance run: the existing serving chaos
         coherence test (read-your-writes + exactly-once under
         drop/disconnect/duplicate, caching ON) re-runs with every
         package lock/queue/RCU-publish boundary perturbed from seed 8 —
-        wire chaos AND schedule chaos at once. The coherence asserts
-        inside the test body are the invariant; the decision log proves
-        the schedule pressure was real."""
+        wire chaos AND schedule chaos at once — PLUS every seed the
+        committed corpus (tests/sched_corpus.json, fed by ``cli
+        explore``) ever recorded as failing: a fixed interleaving bug
+        stays fixed. The coherence asserts inside the test body are the
+        invariant; the decision log proves the schedule pressure was
+        real."""
+        import os
+
         from test_serving import TestServingChaosCoherence
 
-        explorer.install(seed=8)
-        try:
-            TestServingChaosCoherence(
-            ).test_read_your_writes_and_exactly_once_under_chaos()
-            d = explorer.decisions()
-            assert sum(len(v) for v in d.values()) > 50
-            assert any(s.startswith("rcu-publish:") for s in d)
-            assert any(s.startswith("queue.") for s in d)
-        finally:
-            explorer.uninstall()
+        corpus_path = os.path.join(
+            os.path.dirname(__file__), "sched_corpus.json"
+        )
+        seeds = [8] + [
+            s for s in explorer.corpus_seeds(corpus_path, _COHERENCE_NODE)
+            if s != 8
+        ]
+        for seed in seeds:
+            explorer.install(seed=seed)
+            try:
+                TestServingChaosCoherence(
+                ).test_read_your_writes_and_exactly_once_under_chaos()
+                d = explorer.decisions()
+                assert sum(len(v) for v in d.values()) > 50, seed
+                assert any(s.startswith("rcu-publish:") for s in d), seed
+                assert any(s.startswith("queue.") for s in d), seed
+            finally:
+                explorer.uninstall()

@@ -396,10 +396,14 @@ class TestPackageClean:
         assert findings == [], "\n".join(f.render() for f in findings)
 
     def test_registry_matches_the_documented_inventory(self):
-        # ISSUE 8 acceptance: 11 registered checkers (8 + rcu, wireproto,
-        # stale-pragma); the README inventory table tracks this set
-        assert len(CHECKERS) == 11
-        assert {"rcu", "wireproto", "stale-pragma"} <= set(CHECKERS)
+        # ISSUE 10 acceptance: 14 registered checkers (11 + the psmc
+        # conformance pair + flightrec-contract); the README inventory
+        # table tracks this set
+        assert len(CHECKERS) == 14
+        assert {
+            "rcu", "wireproto", "stale-pragma", "spec-conformance",
+            "model-invariants", "flightrec-contract",
+        } <= set(CHECKERS)
 
     def test_module_entry_exits_zero(self):
         """The acceptance form: ``python -m parameter_server_tpu.analysis``
@@ -1120,3 +1124,215 @@ class TestWitnessExport:
         env: dict = {}
         _export_witness_env(env)
         assert witness.ENV_VAR not in env
+
+
+# ---------------------------------------------------------------------------
+# flightrec-contract (ISSUE 10): emitted events vs the postmortem tables
+# ---------------------------------------------------------------------------
+
+_FR_POSTMORTEM = '''
+_CONTEXT_EVENTS = frozenset({"heartbeat.beat"})
+
+def detect(timeline):
+    return [e for e in timeline if e["etype"] == "apply.commit"]
+'''
+
+_FR_EMITTER = '''
+from parameter_server_tpu.utils import flightrec
+
+def apply(batch):
+    flightrec.record("apply.commit", n=len(batch))
+
+def beat():
+    flightrec.record("heartbeat.beat")
+'''
+
+
+class TestFlightrecContract:
+    def _run_fr(self, sources):
+        return analyze_sources(
+            sources, checkers=_only("flightrec-contract")
+        )
+
+    def test_lockstep_inventories_pass(self):
+        assert self._run_fr({
+            "utils/postmortem.py": _FR_POSTMORTEM,
+            "parallel/x.py": _FR_EMITTER,
+        }) == []
+
+    def test_emitted_but_unknown_event_fires_at_the_record_site(self):
+        src = _FR_EMITTER + (
+            '\ndef mystery():\n'
+            '    flightrec.record("rpc.mystery", cid=1)\n'
+        )
+        fs = self._run_fr({
+            "utils/postmortem.py": _FR_POSTMORTEM,
+            "parallel/x.py": src,
+        })
+        assert len(fs) == 1, [f.render() for f in fs]
+        assert fs[0].path == "parallel/x.py"
+        assert "'rpc.mystery'" in fs[0].message
+        assert "never heard of it" in fs[0].message
+
+    def test_stitched_but_never_emitted_event_fires_at_the_table(self):
+        # the rename drift: the detector keys off an event nobody emits
+        src = _FR_EMITTER.replace('"apply.commit"', '"apply.commit2"')
+        fs = self._run_fr({
+            "utils/postmortem.py": _FR_POSTMORTEM,
+            "parallel/x.py": src,
+        })
+        msgs = {f.message for f in fs}
+        assert any(
+            "'apply.commit'" in m and "no record() call emits it" in m
+            for m in msgs
+        ), msgs
+        # the renamed emission is ALSO unknown — both directions fire
+        assert any("'apply.commit2'" in m for m in msgs)
+
+    def test_from_import_alias_counts_as_emission(self):
+        src = (
+            "from parameter_server_tpu.utils.flightrec import record as rec\n"
+            "def f():\n"
+            '    rec("heartbeat.beat")\n'
+            '    rec("apply.commit")\n'
+        )
+        assert self._run_fr({
+            "utils/postmortem.py": _FR_POSTMORTEM,
+            "parallel/y.py": src,
+        }) == []
+
+    def test_plain_dotted_import_counts_as_emission(self):
+        # `import pkg.utils.flightrec` binds only the top-level
+        # package, so the call arrives as the full dotted chain — it
+        # must still count as an emission (both names: asname too)
+        src = (
+            "import parameter_server_tpu.utils.flightrec\n"
+            "import parameter_server_tpu.utils.flightrec as fr\n"
+            "def f():\n"
+            "    parameter_server_tpu.utils.flightrec.record("
+            '"heartbeat.beat")\n'
+            '    fr.record("apply.commit")\n'
+        )
+        assert self._run_fr({
+            "utils/postmortem.py": _FR_POSTMORTEM,
+            "parallel/y.py": src,
+        }) == []
+
+    def test_conditional_etype_branches_all_count(self):
+        src = _FR_EMITTER + (
+            "\ndef either(ok):\n"
+            '    flightrec.record("a.good" if ok else "a.bad")\n'
+        )
+        fs = self._run_fr({
+            "utils/postmortem.py": _FR_POSTMORTEM
+            + '\n_MORE = [e for e in () if e["etype"] in ("a.good",)]\n',
+            "parallel/x.py": src,
+        })
+        # a.good is known via the membership test; a.bad is not
+        assert len(fs) == 1 and "'a.bad'" in fs[0].message
+
+    def test_skipped_without_a_postmortem_module(self):
+        assert self._run_fr({"parallel/x.py": _FR_EMITTER}) == []
+
+    def test_real_package_tables_are_in_lockstep(self):
+        from parameter_server_tpu.analysis.flightreccontract import (
+            emitted_events,
+            known_events,
+        )
+
+        index = load_package()
+        emitted, known = emitted_events(index), known_events(index)
+        assert set(emitted) == set(known)
+        # the contract is non-trivial on the real tree: both detector
+        # literals and pass-through declarations participate
+        assert "apply.commit" in known
+        assert "heartbeat.beat" in known
+        assert len(known) > 15
+
+    def test_detector_events_convenience_set_is_pinned(self):
+        # _DETECTOR_EVENTS is a hand-maintained convenience copy of the
+        # detectors' etype literals (the checker deliberately derives
+        # "known" from the comparisons instead). Pin the copy to the
+        # derivation, or a new detector would have its events reported
+        # as UNINTERPRETED by the runtime unknown_events() check — the
+        # exact silent-drift class flightrec-contract exists to kill.
+        from parameter_server_tpu.analysis.flightreccontract import (
+            known_events,
+        )
+        from parameter_server_tpu.utils import postmortem
+
+        derived = set(known_events(load_package()))
+        assert postmortem._DETECTOR_EVENTS == (
+            derived - postmortem._CONTEXT_EVENTS
+        )
+
+
+# ---------------------------------------------------------------------------
+# severity tiers (ISSUE 10): error/warn findings, tiered exit codes
+# ---------------------------------------------------------------------------
+
+
+class TestSeverityTiers:
+    _VIOLATION = (
+        "import threading\nimport time\n"
+        "_lk = threading.Lock()\n"
+        "def m():\n"
+        "    with _lk:\n"
+        "        time.sleep(1)\n"
+    )
+
+    def _main(self, argv):
+        from parameter_server_tpu.analysis.__main__ import main
+
+        return main(argv)
+
+    def test_severity_defaults_to_error(self):
+        from parameter_server_tpu.analysis import severity_of
+
+        assert severity_of("blocking-under-lock") == "error"
+        assert severity_of("blocking-under-lock", None) == "error"
+
+    def test_config_warn_list_demotes_a_checker(self):
+        from parameter_server_tpu.analysis import severity_of
+
+        cfg = PslintConfig(warn=["blocking-under-lock"])
+        assert severity_of("blocking-under-lock", cfg) == "warn"
+        assert severity_of("lock-order", cfg) == "error"
+
+    def test_error_findings_exit_1_and_json_says_error(self, tmp_path, capsys):
+        import json as json_mod
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "a.py").write_text(self._VIOLATION)
+        assert self._main(["--root", str(pkg), "--json"]) == 1
+        out = json_mod.loads(capsys.readouterr().out)
+        assert out[0]["severity"] == "error"
+
+    def test_warn_only_findings_exit_2(self, tmp_path, capsys):
+        import json as json_mod
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "a.py").write_text(self._VIOLATION)
+        (tmp_path / "pyproject.toml").write_text(
+            '[tool.pslint]\nwarn = ["blocking-under-lock"]\n'
+        )
+        assert self._main(["--root", str(pkg), "--json"]) == 2
+        out = json_mod.loads(capsys.readouterr().out)
+        assert out[0]["severity"] == "warn"
+        # human rendering tags the demoted finding
+        assert self._main(["--root", str(pkg)]) == 2
+        text = capsys.readouterr().out
+        assert "[warn]" in text
+
+    def test_clean_package_exits_0(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "a.py").write_text("x = 1\n")
+        assert self._main(["--root", str(pkg)]) == 0
+
+    def test_baseline_help_documents_line_insensitive_matching(self, capsys):
+        with pytest.raises(SystemExit):
+            self._main(["--help"])
+        assert "LINE-INSENSITIVE" in capsys.readouterr().out

@@ -1,0 +1,502 @@
+"""psmc (ISSUE 10, fast tier-1): the explicit-state protocol model
+checker, its spec suite, the spec<->code conformance diff, the lockset
+race witness, and the ``cli check`` / seed-corpus surfaces.
+
+The acceptance shapes:
+
+- every spec model VERIFIES (exhausts its tier-1-bounded state space
+  with zero invariant/liveness violations) and every seeded-bug variant
+  is CAUGHT with a counterexample trace — mutation coverage for the
+  checker itself;
+- checking is DETERMINISTIC: same bounds => same state count, same
+  (shortest) counterexample;
+- the conformance diff between ``analysis/specs/`` assumptions and the
+  AST-derived code tables is EMPTY on the real package, and a renamed
+  cmd in a crafted snippet package produces a drift finding;
+- the race witness reports a crafted unlocked write pair (true
+  positive), stays silent on the locked twin (true negative), and an
+  armed run of the real serving chaos-coherence test reports ZERO
+  races;
+- the bounded ``cli check`` entry exits 0 over the real package fast
+  enough for tier-1.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from parameter_server_tpu.analysis import explorer, racewitness
+from parameter_server_tpu.analysis.conformance import (
+    conformance_diff,
+    derive_code_tables,
+)
+from parameter_server_tpu.analysis.core import PackageIndex
+from parameter_server_tpu.analysis.model import Spec, check, freeze
+from parameter_server_tpu.analysis.specs import SPECS
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+class _Counter(Spec):
+    """Tiny crafted spec: a counter stepping 0..limit by +1 or +2; the
+    invariant bans reaching ``bad``. BFS must find the SHORTEST route."""
+
+    name = "counter"
+
+    def __init__(self, limit: int = 8, bad: int | None = None):
+        self.limit = limit
+        self.bad = bad
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, s):
+        return [
+            (f"+{d}", s + d) for d in (1, 2) if s + d <= self.limit
+        ]
+
+    def invariant(self, s):
+        if self.bad is not None and s == self.bad:
+            return f"reached {s}"
+        return None
+
+    def liveness(self, s):
+        return None if s == self.limit else f"stuck at {s}"
+
+
+class TestEngine:
+    def test_freeze_canonicalizes_into_hashables(self):
+        a = freeze({"b": [1, 2], "a": {3, 1}})
+        b = freeze({"a": {1, 3}, "b": (1, 2)})
+        assert a == b
+        assert hash(a) == hash(b)
+        assert freeze({"a": 1}) != freeze({"a": 2})
+
+    def test_bfs_counterexample_is_shortest(self):
+        r = check(_Counter(limit=8, bad=6))
+        assert r.violation is not None
+        # 6 is reachable in 3 steps (+2 +2 +2); BFS must not report a
+        # longer route
+        assert len(r.violation.trace) == 3
+        assert r.violation.trace == ["+2", "+2", "+2"]
+
+    def test_clean_spec_verifies_complete(self):
+        r = check(_Counter(limit=8))
+        assert r.ok and r.complete
+        assert r.states == 9  # 0..8
+
+    def test_state_cap_reports_incomplete(self):
+        r = check(_Counter(limit=100), max_states=10)
+        assert not r.complete
+
+    def test_probe_walks_find_bugs_past_the_cap_deterministically(self):
+        a = check(
+            _Counter(limit=5000, bad=4999), max_states=10,
+            probe_seeds=4, probe_len=6000, seed=7,
+        )
+        b = check(
+            _Counter(limit=5000, bad=4999), max_states=10,
+            probe_seeds=4, probe_len=6000, seed=7,
+        )
+        assert a.violation is not None and not a.complete
+        assert b.violation is not None
+        assert a.violation.trace == b.violation.trace
+
+
+# ---------------------------------------------------------------------------
+# the spec suite: verification + mutation coverage + determinism
+# ---------------------------------------------------------------------------
+
+
+class TestSpecSuite:
+    @pytest.mark.parametrize("name", sorted(SPECS))
+    def test_tier1_bounds_verify_clean_and_complete(self, name):
+        r = check(SPECS[name].tier1())
+        assert r.ok, r.violation.render()
+        assert r.complete, f"{name}: state cap hit at tier-1 bounds"
+        assert r.states > 10  # the bounds actually exercise something
+
+    @pytest.mark.parametrize(
+        "name,bug",
+        [(n, b) for n in sorted(SPECS) for b in SPECS[n].BUGS],
+    )
+    def test_every_seeded_bug_is_caught(self, name, bug):
+        r = check(SPECS[name].make(bug=bug))
+        assert r.violation is not None, (
+            f"{name}/{bug}: the checker lost its teeth"
+        )
+        assert r.violation.trace, "counterexample must be replayable"
+        assert r.violation.kind in ("invariant", "liveness")
+
+    def test_dropped_dedup_fires_exactly_once_with_minimal_trace(self):
+        # THE issue example: mutate the reply-cache model to drop dedup
+        # and the exactly-once invariant fires with a minimal trace —
+        # send, serve, duplicate, serve again
+        r = check(SPECS["exactly-once"].make(bug="no-dedup"))
+        v = r.violation
+        assert v.kind == "invariant"
+        assert "applied 2 times" in v.message
+        assert len(v.trace) == 4, v.render()
+
+    def test_ack_early_needs_the_crash_window(self):
+        # the ack/ledger reorder is only visible through a crash between
+        # them: the counterexample must include the restart
+        r = check(SPECS["exactly-once"].make(bug="ack-early"))
+        assert any("crash" in step for step in r.violation.trace), (
+            r.violation.render()
+        )
+
+    @pytest.mark.parametrize("name", sorted(SPECS))
+    def test_checking_is_deterministic(self, name):
+        a = check(SPECS[name].tier1())
+        b = check(SPECS[name].tier1())
+        assert a.summary() == b.summary()
+        bug = SPECS[name].BUGS[0]
+        va = check(SPECS[name].make(bug=bug)).violation
+        vb = check(SPECS[name].make(bug=bug)).violation
+        assert va.trace == vb.trace
+        assert va.message == vb.message
+
+    def test_unknown_bug_knob_rejected(self):
+        with pytest.raises(ValueError, match="unknown bug"):
+            SPECS["exactly-once"].make(bug="nope")
+
+    def test_violation_renders_replayable_steps(self):
+        v = check(SPECS["rcu"].make(bug="no-bump")).violation
+        text = v.render()
+        assert "replayable steps" in text
+        assert "  1." in text
+
+
+# ---------------------------------------------------------------------------
+# spec <-> code conformance
+# ---------------------------------------------------------------------------
+
+_LEDGER_SNIPPET = """
+import threading
+
+class Shard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._applied_push = {}
+        self.server = RpcServer(
+            self._handle,
+            idempotent_cmds=frozenset({"pull", "dump", "stats"}),
+        )
+
+    def _record_push(self, cid, seq):
+        self._applied_push.setdefault(cid, set()).add(seq)
+
+    def _handle(self, h, arrays):
+        cmd = h["cmd"]
+        if cmd == "push":
+            with self._lock:
+                seen = h["seq"] in self._applied_push.get(h["cid"], ())
+                if not seen:
+                    self._record_push(h["cid"], h["seq"])
+            return {}, {}
+        if cmd == "pull":
+            return {}, {}
+        raise ValueError(cmd)
+"""
+
+
+class TestConformance:
+    def test_real_package_diff_is_empty(self):
+        from parameter_server_tpu.analysis import load_package
+
+        index = load_package()
+        assert conformance_diff(index) == [], "\n".join(
+            f.render() for f in conformance_diff(index)
+        )
+
+    def test_snippet_tables_derive_per_present_subsystem(self):
+        index = PackageIndex.from_sources({"shard.py": _LEDGER_SNIPPET})
+        tables = derive_code_tables(index)
+        assert tables["idempotent_cmds"] == frozenset(
+            {"pull", "dump", "stats"}
+        )
+        assert tables["push_rides_reply_cache"] is True
+        assert tables["ledger_record_under_apply_lock"] is True
+        # no RCU publisher / SSP clock in this tree: their keys absent,
+        # so their spec assumptions are not judged
+        assert "publish_sites" not in tables
+        assert "retire_delegates_to_finish" not in tables
+
+    def test_renamed_cmd_is_a_drift_finding(self):
+        # the ISSUE's drift shape: rename a reply-cache-exempt cmd in a
+        # snippet package => the exactly-once model's declared exemption
+        # set no longer matches the derived table
+        src = _LEDGER_SNIPPET.replace('"stats"', '"statsx"')
+        index = PackageIndex.from_sources({"shard.py": src})
+        fs = conformance_diff(index)
+        assert len(fs) == 1, [f.render() for f in fs]
+        f = fs[0]
+        assert f.checker == "spec-conformance"
+        assert "idempotent_cmds" in f.message
+        assert "exactly-once" in f.message
+        assert "drifted" in f.message
+
+    def test_exempting_push_is_a_drift_finding(self):
+        # push replies MUST ride the exactly-once reply cache; exempting
+        # push breaks a different assumption than renaming stats
+        src = _LEDGER_SNIPPET.replace(
+            '{"pull", "dump", "stats"}', '{"pull", "dump", "stats", "push"}'
+        )
+        index = PackageIndex.from_sources({"shard.py": src})
+        msgs = " ".join(f.message for f in conformance_diff(index))
+        assert "push_rides_reply_cache" in msgs
+
+    def test_unlocked_ledger_record_is_a_drift_finding(self):
+        src = _LEDGER_SNIPPET.replace(
+            "            with self._lock:\n"
+            "                seen = h[\"seq\"] in "
+            "self._applied_push.get(h[\"cid\"], ())\n"
+            "                if not seen:\n"
+            "                    self._record_push(h[\"cid\"], h[\"seq\"])",
+            "            seen = h[\"seq\"] in "
+            "self._applied_push.get(h[\"cid\"], ())\n"
+            "            if not seen:\n"
+            "                self._record_push(h[\"cid\"], h[\"seq\"])",
+        )
+        assert "with self._lock" not in src  # the replace really landed
+        index = PackageIndex.from_sources({"shard.py": src})
+        msgs = " ".join(f.message for f in conformance_diff(index))
+        assert "ledger_record_under_apply_lock" in msgs
+
+
+# ---------------------------------------------------------------------------
+# lockset race witness
+# ---------------------------------------------------------------------------
+
+
+class _SharedBox:
+    def __init__(self):
+        self.x = 0
+
+
+class TestRaceWitness:
+    def _hammer(self, obj, lock=None, threads=2, n=200):
+        def work():
+            for _ in range(n):
+                if lock is not None:
+                    with lock:
+                        obj.x += 1
+                else:
+                    obj.x += 1
+
+        ts = [threading.Thread(target=work) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    def test_true_positive_unlocked_write_pair(self):
+        racewitness.install()
+        try:
+            box = _SharedBox()
+            racewitness.track(box, ("x",), "box")
+            self._hammer(box)
+            reps = racewitness.reports()
+            assert len(reps) == 1  # deduped per (object, field)
+            assert reps[0].kind in ("write/write", "read/write")
+            assert reps[0].attr == "x"
+            # both stacks point at the access sites
+            assert any("work" in line for line in reps[0].stack_a)
+            assert any("work" in line for line in reps[0].stack_b)
+            with pytest.raises(AssertionError, match="data race"):
+                racewitness.assert_no_races()
+        finally:
+            racewitness.clear()
+            racewitness.uninstall()
+
+    def test_true_negative_locked_twin(self):
+        racewitness.install()
+        try:
+            box = _SharedBox()
+            lock = racewitness.wrap(threading.Lock())
+            racewitness.track(box, ("x",), "box")
+            self._hammer(box, lock=lock)
+            assert racewitness.reports() == []
+            racewitness.assert_no_races()
+        finally:
+            racewitness.clear()
+            racewitness.uninstall()
+
+    def test_untracked_instances_stay_silent(self):
+        racewitness.install()
+        try:
+            tracked = _SharedBox()
+            racewitness.track(tracked, ("x",), "tracked")
+            free = _SharedBox()  # same class, never registered
+            self._hammer(free)
+            assert racewitness.reports() == []
+        finally:
+            racewitness.clear()
+            racewitness.uninstall()
+
+    def test_track_is_noop_while_disarmed(self):
+        box = _SharedBox()
+        racewitness.track(box, ("x",), "box")
+        self._hammer(box)
+        assert racewitness.reports() == []
+        assert not racewitness.installed()
+
+    def test_uninstall_restores_factories_and_attributes(self):
+        raw = threading.Lock
+        racewitness.install()
+        box = _SharedBox()
+        racewitness.track(box, ("x",), "box")
+        box.x = 41
+        racewitness.uninstall()
+        assert threading.Lock is raw
+        assert box.x == 41  # values survive descriptor removal
+        box.x += 1
+        assert box.x == 42
+
+    def test_armed_serving_chaos_coherence_reports_zero_races(self):
+        """THE acceptance run: the real serving chaos-coherence test
+        (read-your-writes + exactly-once under drop/disconnect/duplicate
+        with caching ON) under an armed race witness — every registered
+        shared object (residual accumulator, encode-cache budget, push
+        ledger, heat sketch, key-cache generation) is lockset-checked at
+        every access, and the run must witness ZERO races."""
+        from test_serving import TestServingChaosCoherence
+
+        racewitness.install()
+        try:
+            TestServingChaosCoherence(
+            ).test_read_your_writes_and_exactly_once_under_chaos()
+            racewitness.assert_no_races()
+        finally:
+            racewitness.clear()
+            racewitness.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# cli check + the seed corpus (cli explore's storage)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckCli:
+    def _main(self, argv):
+        from parameter_server_tpu.analysis.__main__ import check_main
+
+        return check_main(argv)
+
+    def test_tier1_bounded_run_verifies_everything(self, capsys):
+        # the tier-1 gate: full suite + conformance, bounded, exit 0
+        assert self._main([]) == 0
+        out = capsys.readouterr().out
+        for name in SPECS:
+            assert name in out
+        assert "verified" in out
+        assert "0 conformance drift" in out
+
+    def test_json_summary_shape(self, capsys):
+        import json
+
+        assert self._main(["--json", "--no-conformance"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["ok"] is True
+        assert {r["spec"] for r in doc["specs"]} == set(SPECS)
+        assert all(r["complete"] for r in doc["specs"])
+
+    def test_state_cap_fails_verification(self, capsys):
+        assert self._main(
+            ["--spec", "exactly-once", "--max-states", "50",
+             "--no-conformance"]
+        ) == 1
+        assert "INCOMPLETE" in capsys.readouterr().out
+
+    def test_bug_mode_demands_a_counterexample(self, capsys):
+        assert self._main(
+            ["--spec", "rcu", "--bug", "no-bump"]
+        ) == 0
+        assert "caught" in capsys.readouterr().out
+
+    def test_bug_mode_requires_exactly_one_spec(self):
+        with pytest.raises(SystemExit):
+            self._main(["--bug", "no-dedup"])
+
+
+class TestSeedCorpus:
+    def test_record_and_load_roundtrip(self, tmp_path):
+        p = str(tmp_path / "corpus.json")
+        assert explorer.load_corpus(p) == {}
+        explorer.record_failing_seeds(p, "t::a", [5, 3])
+        explorer.record_failing_seeds(p, "t::a", [5, 9])
+        explorer.record_failing_seeds(p, "t::b", [1])
+        assert explorer.corpus_seeds(p, "t::a") == [3, 5, 9]
+        assert explorer.corpus_seeds(p, "t::b") == [1]
+        assert explorer.corpus_seeds(p, "t::missing") == []
+
+    def test_foreign_or_torn_corpus_reads_empty(self, tmp_path):
+        p = tmp_path / "corpus.json"
+        p.write_text("{not json")
+        assert explorer.load_corpus(str(p)) == {}
+        p.write_text('{"schema": "other/1", "tests": {"t": [1]}}')
+        assert explorer.load_corpus(str(p)) == {}
+
+    def test_record_refuses_to_clobber_a_foreign_or_torn_corpus(
+        self, tmp_path
+    ):
+        # reading a torn/foreign file as empty is the bootstrap path;
+        # WRITING over one would destroy every committed seed silently
+        for body in ("{not json", '{"schema": "pssched/2", "tests": '
+                     '{"t::x": [7]}}'):
+            p = tmp_path / "corpus.json"
+            p.write_text(body)
+            with pytest.raises(RuntimeError, match="refusing"):
+                explorer.record_failing_seeds(str(p), "t::a", [1])
+            assert p.read_text() == body  # untouched
+        # an empty-but-ours corpus still records fine
+        p.write_text('{"schema": "pssched/1", "tests": {}}')
+        explorer.record_failing_seeds(str(p), "t::a", [1])
+        assert explorer.corpus_seeds(str(p), "t::a") == [1]
+
+    def test_search_seeds_budget_and_failures(self):
+        seen = []
+
+        def runner(seed):
+            return seed not in (3, 5)  # these seeds "fail" the test
+
+        results = []
+        failing = explorer.search_seeds(
+            "t::x", budget=6, start_seed=1, runner=runner,
+            on_result=lambda s, ok: results.append((s, ok)),
+        )
+        del seen
+        assert failing == [3, 5]
+        assert [s for s, _ in results] == [1, 2, 3, 4, 5, 6]
+        assert [ok for _, ok in results] == [
+            True, True, False, True, False, True,
+        ]
+
+    def test_infra_break_preserves_finds_so_far(self):
+        # a runner that RAISES (pytest collection/usage error) aborts
+        # the search but must hand back the failing seeds already found
+        def runner(seed):
+            if seed == 4:
+                raise RuntimeError("pytest could not run")
+            return seed != 2
+
+        with pytest.raises(explorer.SearchError) as ei:
+            explorer.search_seeds("t::x", budget=10, runner=runner)
+        assert ei.value.seed == 4
+        assert ei.value.failing == [2]
+
+    def test_committed_corpus_parses_and_names_the_coherence_test(self):
+        import os
+
+        p = os.path.join(os.path.dirname(__file__), "sched_corpus.json")
+        corpus = explorer.load_corpus(p)
+        assert (
+            "tests/test_serving.py::TestServingChaosCoherence::"
+            "test_read_your_writes_and_exactly_once_under_chaos"
+        ) in corpus
