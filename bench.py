@@ -90,6 +90,14 @@ Sub-benches ("sub"):
                  seeds; measured push payload ratio (>= 3x at int8) and
                  AUC parity (|dAUC| <= 0.002) per arm, plus the
                  residual-norm peak gauge.
+  backend      — transport-neutral KV backend A/B (ISSUE 11 acceptance):
+                 the SAME canonical train_linear client loop on the
+                 socket tier (2 loopback ShardServers) and the in-mesh
+                 GSPMD tier (8-device cpu-sim kv mesh), plus a push-
+                 throughput sweep over keys-per-push that places the
+                 socket/mesh crossover as a number, and the int8
+                 quantized-collective arm (payload bytes ratio + AUC
+                 parity vs the mesh f32 arm at equal seeds).
   serve        — online serving plane A/B (ISSUE 7 acceptance): 256
                  simulated Zipf(1.1) read-mostly clients multiplexed
                  over 16 threads against one shard server; cached
@@ -141,6 +149,7 @@ CHILD_BUDGET_S = {
     "wire_rpc": 300,
     "server_apply": 360,
     "quant_wire": 420,
+    "backend": 420,
     "serve": 300,
 }
 # run order = value order: the contract fields land first, platform-bound
@@ -148,7 +157,7 @@ CHILD_BUDGET_S = {
 CHILD_ORDER = (
     "headline", "pipeline_e2e", "hbm_scale", "ladder", "scale", "word2vec",
     "matrix_fac", "darlin", "spmd_push", "wd_push", "ingest", "wire_rpc",
-    "server_apply", "quant_wire", "serve",
+    "server_apply", "quant_wire", "backend", "serve",
 )
 
 
@@ -1644,6 +1653,143 @@ def child_quant_wire() -> dict:
     return out
 
 
+def child_backend() -> dict:
+    """Transport-neutral KV backend A/B (ISSUE 11 acceptance cell).
+
+    Both backends are driven by the IDENTICAL client code — the
+    canonical ``parallel.backend.train_linear`` loop the parity tests
+    pin — so every ratio below is transport, not client drift:
+
+    - trainer arm: FTRL linear run on socket (2 loopback ShardServers)
+      vs mesh (8-device cpu-sim kv mesh), AUC + ex/s per arm, plus the
+      int8 quantized-collective mesh arm (error feedback preserved):
+      measured payload bytes ratio and |dAUC| vs the mesh f32 arm.
+    - push sweep: keys-per-push U in {2^8..2^16}, pipelined socket
+      pushes vs mesh sharded-update dispatches, rows/sec per side. The
+      compact line carries the large-batch speedup and the CROSSOVER
+      (smallest U where in-mesh wins) — the number that says when to
+      leave the socket tier for ICI."""
+    import jax
+
+    from parameter_server_tpu.kv.updaters import Ftrl
+    from parameter_server_tpu.parallel.backend import (
+        local_socket_backend,
+        train_linear,
+    )
+    from parameter_server_tpu.parallel.meshbackend import MeshBackend
+    from parameter_server_tpu.utils.metrics import wire_counters
+
+    num_keys = 1 << 18
+    kv = min(8, len(jax.devices()))
+
+    def _updater() -> Ftrl:
+        # sized for per-example-mean gradients (see child_quant_wire)
+        return Ftrl(alpha=1.0, beta=BETA, lambda_l1=1e-4, lambda_l2=L2)
+
+    def _socket():
+        return local_socket_backend(_updater, num_keys, num_servers=2)
+
+    out: dict = {
+        "platform": "cpu-sim",
+        "config": f"keys=2^18 mesh_kv={kv} socket_servers=2",
+    }
+
+    # -- trainer arm: one loop, three transports ---------------------------
+    rng = np.random.default_rng(23)
+    nnz, bsz, nb = 32, 2048, 12
+    w_true = rng.normal(size=num_keys - 1) * 1.2
+    kb = rng.integers(0, num_keys - 1, size=(bsz * nb, nnz))
+    logits = w_true[kb].sum(axis=1) / np.sqrt(nnz)
+    y = (rng.random(bsz * nb) < 1 / (1 + np.exp(-logits))).astype(
+        np.float64
+    )
+
+    sb = _socket()
+    try:
+        train_linear(sb, kb[: bsz * 2], y[: bsz * 2], bsz)  # warm jits/TCP
+        t0 = time.perf_counter()
+        res_s = train_linear(sb, kb, y, bsz)
+        out["train_ex_per_sec_socket"] = round(
+            res_s["examples"] / (time.perf_counter() - t0), 1
+        )
+        out["train_auc_socket"] = round(res_s["auc"], 4)
+    finally:
+        sb.close()
+
+    payloads: dict[str, int] = {}
+    for quant in ("off", "int8"):
+        mb = MeshBackend(_updater(), num_keys, kv_shards=kv, quant=quant)
+        train_linear(mb, kb[: bsz * 2], y[: bsz * 2], bsz)  # compile
+        pay0 = wire_counters.get("mesh_push_payload_bytes")
+        t0 = time.perf_counter()
+        res_m = train_linear(mb, kb, y, bsz)
+        dt = time.perf_counter() - t0
+        payloads[quant] = (
+            wire_counters.get("mesh_push_payload_bytes") - pay0
+        )
+        tag = "mesh" if quant == "off" else "mesh_int8"
+        out[f"train_ex_per_sec_{tag}"] = round(res_m["examples"] / dt, 1)
+        out[f"train_auc_{tag}"] = round(res_m["auc"], 4)
+    out["auc_delta_int8"] = round(
+        abs(out["train_auc_mesh_int8"] - out["train_auc_mesh"]), 4
+    )
+    out["quant_bytes_ratio_int8"] = round(
+        payloads["off"] / max(payloads["int8"], 1), 2
+    )
+    out["push_payload_mb_f32"] = round(payloads["off"] / 1e6, 3)
+    out["push_payload_mb_int8"] = round(payloads["int8"] / 1e6, 3)
+
+    # -- push-throughput sweep: where does in-mesh win? --------------------
+    mb = MeshBackend(_updater(), num_keys, kv_shards=kv)
+    sb = _socket()
+    sweep: dict = {}
+    try:
+        for u_log2 in (8, 10, 12, 14, 16):
+            u = 1 << u_log2
+            keys = np.sort(
+                rng.choice(
+                    np.arange(1, num_keys, dtype=np.int64), size=u,
+                    replace=False,
+                )
+            )
+            g = (rng.normal(size=(u, 1)) * 0.01).astype(np.float32)
+            reps = max(4, min(48, (1 << 21) // u))
+            mb.push(keys, g)
+            mb.flush()  # compile this bucket outside the timed window
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                mb.push(keys, g)
+            mb.flush()
+            mesh_rate = reps * u / (time.perf_counter() - t0)
+            sb.push(keys, g)  # warm the server's apply bucket
+            sb.flush()
+            t0 = time.perf_counter()
+            futs = [sb.push_async(keys, g) for _ in range(reps)]
+            for f in futs:
+                f.result()
+            sock_rate = reps * u / (time.perf_counter() - t0)
+            sweep[f"u{u}"] = {
+                "mesh_rows_per_sec": round(mesh_rate, 1),
+                "socket_rows_per_sec": round(sock_rate, 1),
+                "speedup": round(mesh_rate / sock_rate, 2),
+            }
+    finally:
+        sb.close()
+    out["push_sweep"] = sweep
+    out["mesh_vs_socket_push_speedup"] = sweep["u65536"]["speedup"]
+    # the crossover: smallest keys-per-push where the in-mesh path wins
+    # (0 = socket won everywhere in the sweep)
+    out["crossover_keys_per_push"] = next(
+        (
+            1 << lg
+            for lg in (8, 10, 12, 14, 16)
+            if sweep[f"u{1 << lg}"]["speedup"] >= 1.0
+        ),
+        0,
+    )
+    return out
+
+
 #: the serve cell's shard server, run in its OWN process (real serving
 #: topology — a same-process server shares the client GIL and bottlenecks
 #: both arms on each other). Prints ADDR on bind; on shutdown prints one
@@ -1982,6 +2128,7 @@ _CHILDREN = {
     "wire_rpc": child_wire_rpc,
     "server_apply": child_server_apply,
     "quant_wire": child_quant_wire,
+    "backend": child_backend,
     "serve": child_serve,
 }
 
@@ -2118,7 +2265,7 @@ def main() -> None:
             _cpu_sim_env()
             if name in (
                 "spmd_push", "wd_push", "wire_rpc", "server_apply",
-                "quant_wire", "serve",
+                "quant_wire", "backend", "serve",
             )
             else env
         )
@@ -2126,7 +2273,7 @@ def main() -> None:
         results[name] = r
         if "error" in r and not degraded and name not in (
             "spmd_push", "wd_push", "wire_rpc", "server_apply", "quant_wire",
-            "serve",
+            "backend", "serve",
         ):
             # the accelerator may have wedged mid-suite: re-probe, and run
             # everything that's left on the CPU fallback if it's gone
@@ -2207,6 +2354,7 @@ def main() -> None:
             "wire_rpc": wire_rpc,
             "server_apply": results.get("server_apply", {}),
             "quant_wire": results.get("quant_wire", {}),
+            "backend": results.get("backend", {}),
             "serve": results.get("serve", {}),
         },
         "suite_wall_s": round(time.perf_counter() - t_start, 1),
@@ -2301,6 +2449,14 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
             "quant": _pick(
                 "quant_wire", "push_bytes_ratio_int8", "auc_delta_int8",
                 "holdout_auc_f32", "holdout_auc_int8"),
+            # the transport-neutral backend's acceptance numbers (ISSUE
+            # 11): in-mesh vs socket push throughput at the large-batch
+            # end, the crossover point where in-mesh starts winning, the
+            # quantized-collective payload ratio and its AUC parity
+            "backend": _pick(
+                "backend", "mesh_vs_socket_push_speedup",
+                "crossover_keys_per_push", "quant_bytes_ratio_int8",
+                "auc_delta_int8"),
             # the serving plane's acceptance numbers (ISSUE 7): cached
             # pull QPS vs the uncached baseline at 256 Zipf clients,
             # cache hit rate, encode-coalesce ratio, p99 under shedding
@@ -2313,7 +2469,20 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
         compact["last_tpu_capture"] = full["last_tpu_capture"]
     if "error" in full.get("raw", {}):
         compact["error"] = str(full["raw"]["error"])[-120:]
-    # belt and braces: the contract fields must survive the tail buffer
+    # belt and braces: the contract fields must survive the tail buffer.
+    # Degrade by shedding whole sub-blocks oldest-acceptance-first (the
+    # newest cells' acceptance numbers are what a fresh capture is FOR;
+    # everything always lands in the full results file regardless), and
+    # only pop the whole sub dict if even that isn't enough.
+    drop_order = (
+        "hbm", "ingest", "darlin", "mf", "w2v", "ladder", "scale", "wd",
+        "spmd", "e2e", "pallas_ftrl", "fused_push", "rpc", "srv",
+        "quant", "serve", "backend",
+    )
+    for name in drop_order:
+        if len(json.dumps(compact)) <= 1400:
+            break
+        compact["sub"].pop(name, None)
     if len(json.dumps(compact)) > 1400:
         compact.pop("sub", None)
     return compact

@@ -234,6 +234,27 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     ck.add_argument("--json", action="store_true")
 
+    bk = sub.add_parser(
+        "backend",
+        help="drive the canonical linear trainer loop through the "
+        "configured transport-neutral KV backend ([mesh] section, "
+        "parallel/backend.py): 'mesh' runs in-process GSPMD collectives "
+        "over the local device mesh, 'socket' spins loopback "
+        "ShardServers — one synthetic workload, either transport, JSON "
+        "metrics (AUC, ex/s, payload bytes) on stdout",
+    )
+    bk.add_argument("--app_file", required=True, help="JSON/TOML PSConfig")
+    bk.add_argument(
+        "--examples", type=int, default=1 << 14,
+        help="synthetic examples to stream through the loop",
+    )
+    bk.add_argument("--batch", type=int, default=2048)
+    bk.add_argument("--nnz", type=int, default=16, help="features/example")
+    bk.add_argument(
+        "--servers", type=int, default=2,
+        help="socket backend only: in-process loopback shard servers",
+    )
+
     ex = sub.add_parser(
         "explore",
         help="budgeted schedule-seed search (analysis/explorer.py): run "
@@ -644,6 +665,63 @@ def run_evaluate(cfg: PSConfig, args: argparse.Namespace) -> dict:
     )
 
 
+def run_backend(cfg: PSConfig, args: argparse.Namespace) -> dict:
+    """One synthetic linear workload through the configured PSBackend
+    (the ``[mesh]`` section picks the transport): the canonical
+    ``train_linear`` loop that the backend-parity tests and the bench's
+    ``backend`` cell also drive — so what this command measures is the
+    production client path, not a demo fork of it."""
+    import time
+
+    import numpy as np
+
+    from parameter_server_tpu.models.linear import updater_from_config
+    from parameter_server_tpu.parallel.backend import (
+        local_socket_backend,
+        make_backend,
+        train_linear,
+    )
+    from parameter_server_tpu.utils.metrics import wire_counters
+
+    num_keys = cfg.data.num_keys
+    n = max(args.examples // args.batch, 1) * args.batch
+    rng = np.random.default_rng(cfg.seed or 7)
+    w_true = rng.normal(size=num_keys - 1)
+    kb = rng.integers(0, num_keys - 1, size=(n, args.nnz))
+    logits = w_true[kb].sum(axis=1) / np.sqrt(args.nnz)
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+
+    if cfg.mesh.backend == "socket":
+        backend = local_socket_backend(
+            lambda: updater_from_config(cfg), num_keys,
+            num_servers=args.servers, cfg=cfg,
+        )
+    else:
+        backend = make_backend(cfg)
+    pay0 = wire_counters.get("mesh_push_payload_bytes") + wire_counters.get(
+        "wire_push_payload_bytes"
+    )
+    try:
+        t0 = time.perf_counter()
+        out = train_linear(backend, kb, y, args.batch)
+        dt = time.perf_counter() - t0
+        payload = (
+            wire_counters.get("mesh_push_payload_bytes")
+            + wire_counters.get("wire_push_payload_bytes")
+            - pay0
+        )
+        return {
+            "backend": cfg.mesh.backend,
+            "auc": round(out["auc"], 4),
+            "examples": out["examples"],
+            "ex_per_sec": round(out["examples"] / dt, 1),
+            "push_payload_mb": round(payload / 1e6, 3),
+            "stats": backend.stats(),
+        }
+    finally:
+        backend.close()  # owned loopback servers shut down with it
+
+
 def run_stats(args: argparse.Namespace) -> dict:
     """The cluster dashboard (ref: the reference scheduler's printed
     table): query a live coordinator's ``telemetry`` command and print
@@ -796,6 +874,8 @@ def main(argv: list[str] | None = None) -> int:
         )
     if args.cmd == "train":
         out = run_train(cfg, args)
+    elif args.cmd == "backend":
+        out = run_backend(cfg, args)
     elif args.cmd == "evaluate":
         out = run_evaluate(cfg, args)
     elif args.cmd == "convert":

@@ -97,6 +97,25 @@ def dequantize_segments(q, scale, num_bytes: int = 1, seg: int = 256):
     return _jit_cores(num_bytes, seg)[1](q, scale)
 
 
+def dequantize_flat(q, scale, seg: int = 256):
+    """Trace-safe decode of an arbitrary-length payload (the host codec's
+    trimmed wire shape): re-pad ``q`` to the segment multiple, scale per
+    segment, trim. Shapes are static under jit, so this inlines into a
+    larger program — the mesh backend's quantized push dequantizes with
+    it INSIDE the sharded update, after the int8 payload crossed the
+    collective boundary (EQuARX: quantize before the exchange,
+    dequantize after)."""
+    import jax.numpy as jnp
+
+    n = int(q.shape[0])
+    flat = q.astype(jnp.float32)
+    pad = (-n) % seg
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.float32)])
+    out = (flat.reshape(-1, seg) * scale[:, None].astype(jnp.float32))
+    return out.reshape(-1)[:n]
+
+
 @dataclass(frozen=True)
 class SegmentQuantizer:
     """The host wire codec: int8/int16 payload + one f32 scale per ``seg``

@@ -34,6 +34,27 @@ from parameter_server_tpu.kv.updaters import Updater
 State = dict[str, jax.Array]
 
 
+def pad_state_rows(state: State, num_rows: int) -> State:
+    """Zero-extend every table of ``state`` on axis 0 up to ``num_rows``
+    (identity when already there). Pad rows obey the store's pad-row
+    invariant — exactly zero, never pushed (the data layer only emits
+    keys below the real ``num_keys``), so they are invisible to pulls,
+    dumps and nnz counts. This is what lets the sharded tiers accept an
+    arbitrary ``num_keys`` on any kv-axis size: the table is padded up
+    to the next axis multiple and the extra rows stay inert."""
+    have = next(iter(state.values())).shape[0]
+    if have == num_rows:
+        return state
+    if have > num_rows:
+        raise ValueError(f"cannot pad {have} rows down to {num_rows}")
+    return {
+        k: jnp.concatenate(
+            [v, jnp.zeros((num_rows - have, *v.shape[1:]), v.dtype)], axis=0
+        )
+        for k, v in state.items()
+    }
+
+
 @functools.partial(jax.jit, static_argnums=0)
 def pull(updater: Updater, state: State, idx: jax.Array) -> jax.Array:
     """Gather weights for (unique, padded) key indices: (U,) -> (U, vdim)."""

@@ -45,6 +45,13 @@ if _os.environ.get("PS_RACE_WITNESS", "") not in ("", "0"):
     _racewitness.maybe_install_from_env()
 
 from parameter_server_tpu.parallel import runtime  # noqa: F401
+from parameter_server_tpu.parallel.backend import (  # noqa: F401
+    PSBackend,
+    SocketBackend,
+    make_backend,
+    train_linear,
+)
+from parameter_server_tpu.parallel.meshbackend import MeshBackend  # noqa: F401
 from parameter_server_tpu.parallel.mesh import make_mesh  # noqa: F401
 from parameter_server_tpu.parallel.runtime import Runtime  # noqa: F401
 from parameter_server_tpu.parallel.spmd import (  # noqa: F401

@@ -2166,7 +2166,15 @@ def run_worker(
     ctl.kv_get("scheduler_init_done", block=True, timeout=120)
     servers = _connect_servers(ctl, rank, num_servers, cfg)
     ranges = KeyRange(0, cfg.data.num_keys).even_divide(num_servers)
-    begins = np.array([r.begin for r in ranges] + [cfg.data.num_keys])
+    # the transport-neutral data plane (parallel/backend.py): this loop
+    # only ever sees global keys; the backend owns the range fan-out
+    # (slice against server ranges, concurrent per-shard wire calls,
+    # merge) that used to be hand-rolled here
+    from parameter_server_tpu.parallel.backend import SocketBackend
+
+    backend = SocketBackend(
+        servers, ranges, cfg.data.num_keys, own_handles=False
+    )
     from parameter_server_tpu.data.batch import training_builder
 
     builder = training_builder(cfg)
@@ -2264,24 +2272,14 @@ def run_worker(
             with trace.span("step", cat="step", step=step):
                 with trace.span("step.ssp_wait", cat="step"):
                     ctl.ssp_wait(rank, step)
-                # slice the batch's (sorted) unique keys against ranges
+                # the batch's (sorted) unique GLOBAL keys; the backend
+                # does the range slicing + concurrent per-shard wire
                 real = b.unique_keys[1 : b.num_unique]
-                bounds = np.searchsorted(real, begins)
-                # range-relative int64; the handle picks the wire dtype
-                segs = [
-                    real[bounds[s] : bounds[s + 1]] - ranges[s].begin
-                    for s in range(num_servers)
-                ]
                 with trace.span("step.pull", cat="step"):
-                    pull_futs = [
-                        sh.pull_async(seg) for sh, seg in zip(servers, segs)
-                    ]
-                    pulls = [f.result() for f in pull_futs]
+                    pulled = backend.pull(real)
                 with trace.span("step.compute", cat="step"):
                     w_u = np.zeros(len(b.unique_keys), dtype=np.float32)
-                    w_u[1 : b.num_unique] = (
-                        np.concatenate(pulls) if pulls else []
-                    )
+                    w_u[1 : b.num_unique] = pulled.ravel()
                     loss, probs, g = grad_step(
                         w_u, b.values, b.local_ids, b.row_ids, b.labels,
                         b.example_mask,
@@ -2289,12 +2287,7 @@ def run_worker(
                     g_real = np.asarray(g).ravel()[1 : b.num_unique]
                 # pushes stay in flight past this span's exit; the flow
                 # links (ps.push.inflight) bridge issue to completion
-                futs = [
-                    servers[s].push_async(
-                        segs[s], g_real[bounds[s] : bounds[s + 1]]
-                    )
-                    for s in range(num_servers)
-                ]
+                futs = [backend.push_async(real, g_real)]
             pushes.add(step, futs)
             ex_seen += b.num_examples
             window.append(
@@ -2442,10 +2435,14 @@ def run_scheduler(
         time.sleep(0.5)
 
     servers = _connect_servers(ctl, worker_rank=-1, num_servers=num_servers, cfg=cfg)
-    w = np.zeros(cfg.data.num_keys, dtype=np.float32)
-    for sh in servers:
-        begin, w_range = sh.dump()
-        w[begin : begin + len(w_range)] = w_range.reshape(-1)
+    from parameter_server_tpu.parallel.backend import SocketBackend
+
+    w = SocketBackend(
+        servers,
+        KeyRange(0, cfg.data.num_keys).even_divide(num_servers),
+        cfg.data.num_keys,
+        own_handles=False,
+    ).weights().ravel()
     out: dict[str, Any] = {
         "merged": ctl.progress_merged(),
         "server_stats": [sh.stats() for sh in servers],

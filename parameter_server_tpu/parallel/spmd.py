@@ -15,9 +15,13 @@ Reference analog, mapped one-to-one:
   Server updater application (FTRL/AdaGrad/SGD entries)  -> exact additive
     deltas scattered with ``.at[].add`` (deterministic under padding).
 
-State layout: every table is (num_keys, vdim) sharded over "kv" on axis 0;
-num_keys must divide evenly by the kv axis size. Batches are per-data-shard
-CSRBatches stacked on a leading axis and sharded over "data".
+State layout: every table is (num_keys, vdim) sharded over "kv" on axis 0.
+``num_keys`` need not divide the kv axis size: tables are zero-padded up
+to the next axis multiple (``padded_num_keys``) and the pad rows stay
+exactly zero under the store's pad-row invariant (batch keys are always
+below the real ``num_keys``, so no push ever touches them). Batches are
+per-data-shard CSRBatches stacked on a leading axis and sharded over
+"data".
 """
 
 from __future__ import annotations
@@ -49,7 +53,13 @@ def batch_spec() -> P:
 
 
 def shard_state(state: State, mesh: Mesh) -> State:
-    """Place a replicated/host state dict range-sharded over the kv axis."""
+    """Place a replicated/host state dict range-sharded over the kv axis,
+    zero-padding the tables up to the next kv-axis multiple first (the
+    pad rows are inert — see ``kv.store.pad_state_rows``)."""
+    from parameter_server_tpu.kv.store import pad_state_rows
+
+    rows = next(iter(state.values())).shape[0]
+    state = pad_state_rows(state, padded_num_keys(rows, mesh.shape["kv"]))
     sh = NamedSharding(mesh, state_spec())
     return {k: jax.device_put(v, sh) for k, v in state.items()}
 
@@ -258,10 +268,19 @@ def _local_push_quantized(
 PUSH_MODES = ("per_worker", "aggregate", "quantized")
 
 
+def padded_num_keys(num_keys: int, kv_size: int) -> int:
+    """``num_keys`` rounded up to the next multiple of the kv axis size —
+    the table rows the sharded tiers actually allocate. The rows past the
+    real ``num_keys`` are pad rows: exactly zero and never touched (the
+    data layer only emits keys below ``num_keys``), so arbitrary table
+    sizes run on any mesh shape with no semantic change."""
+    if num_keys < 1:
+        raise ValueError(f"num_keys must be >= 1, got {num_keys}")
+    return -(-num_keys // kv_size) * kv_size
+
+
 def _shard_size(num_keys: int, kv_size: int) -> int:
-    if num_keys % kv_size:
-        raise ValueError(f"num_keys {num_keys} not divisible by kv axis {kv_size}")
-    return num_keys // kv_size
+    return padded_num_keys(num_keys, kv_size) // kv_size
 
 
 def _wrap_stepper(step, push_mode: str):

@@ -37,6 +37,7 @@ from parameter_server_tpu.parallel.spmd import (
     make_spmd_predict_step,
     make_spmd_train_multistep,
     make_spmd_train_step,
+    padded_num_keys,
     stack_batches,
     stack_step_groups,
 )
@@ -271,8 +272,14 @@ class PodTrainer:
         self.predict_fn = make_spmd_predict_step(
             self.updater, self.mesh, cfg.data.num_keys
         )
+        # table rows are num_keys rounded up to the kv-axis multiple (pad
+        # rows stay exactly zero — no batch key ever reaches them), so
+        # arbitrary num_keys run on any mesh shape
+        self._table_rows = padded_num_keys(
+            cfg.data.num_keys, self.mesh.shape["kv"]
+        )
         self.state = self.runtime.init_state(
-            lambda: self.updater.init(cfg.data.num_keys, 1)
+            lambda: self.updater.init(self._table_rows, 1)
         )
         self.reporter = reporter or ProgressReporter()
         self.clock = SSPClock(
@@ -670,7 +677,7 @@ class PodTrainer:
         host = self.runtime.state_to_host(self.state)
         return np.asarray(
             self.updater.weights({k: jnp.asarray(v) for k, v in host.items()})
-        )
+        )[: self.cfg.data.num_keys]
 
     def save(self, ckpt_dir, meta: dict | None = None) -> None:
         """Per-host sharded checkpoint (each host writes its key-range
@@ -689,6 +696,27 @@ class PodTrainer:
 
     def load(self, ckpt_dir) -> dict:
         self.state, meta = self.runtime.load_checkpoint(ckpt_dir)
+        rows = next(iter(self.state.values())).shape[0]
+        if rows != self._table_rows:
+            # a checkpoint written on a different mesh shape (or before
+            # padding existed) carries a different pad tail: re-pad the
+            # host replica up to THIS mesh's table rows
+            from parameter_server_tpu.kv.store import pad_state_rows
+
+            host = self.runtime.state_to_host(self.state)
+            host = {
+                k: np.asarray(v)[: self.cfg.data.num_keys]
+                for k, v in host.items()
+            }
+            import jax.numpy as jnp
+
+            host = pad_state_rows(
+                {k: jnp.asarray(v) for k, v in host.items()},
+                self._table_rows,
+            )
+            self.state = self.runtime.state_from_host(
+                {k: np.asarray(v) for k, v in host.items()}
+            )
         self.examples_seen = int(meta.get("examples_seen", 0))
         return meta
 

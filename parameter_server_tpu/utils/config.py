@@ -319,6 +319,32 @@ class ParallelConfig:
 
 
 @dataclass
+class MeshConfig:
+    """Transport-neutral client data plane (parallel/backend.py): which
+    KV backend apps written against ``PSBackend`` bind to. "socket" is
+    the cross-process wire tier (ShardServer + ServerHandle, every
+    filter/recovery feature of PRs 1-7); "mesh" is the in-mesh GSPMD
+    tier (parallel/meshbackend.py) — the KV table is one NamedSharding-
+    sharded array over the kv axis and push/pull lower to collectives
+    over ICI instead of loopback sockets. Rule of thumb: co-located
+    workers+servers in ONE JAX process mesh want "mesh"; anything
+    crossing a process/DCN boundary stays "socket"."""
+
+    backend: str = "socket"  # socket | mesh
+    # kv-axis width of the mesh backend's table sharding; 0 = every
+    # local device (the whole-host mesh)
+    kv_shards: int = 0
+    # quantized push collective (filters/quant.py fused into the sharded
+    # update, EQuARX-style): "off" moves f32 gradients onto the mesh;
+    # "int8"/"int16" move per-segment-scale integer payloads with the
+    # client error-feedback residual preserved (the PR-6 win surviving
+    # the transport change)
+    quant: str = "off"
+    # quantizer segment length (one f32 scale per this many coordinates)
+    quant_seg: int = 256
+
+
+@dataclass
 class FaultConfig:
     """Failure detection / recovery knobs for the multi-process tier
     (ref: heartbeat_info + the scheduler's dead-node handling)."""
@@ -400,6 +426,7 @@ class PSConfig:
     w2v: W2VConfig = field(default_factory=W2VConfig)
     wd: WDConfig = field(default_factory=WDConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
     wire: WireConfig = field(default_factory=WireConfig)
     server: ServerConfig = field(default_factory=ServerConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
@@ -446,6 +473,7 @@ _NESTED = {
     "w2v": W2VConfig,
     "wd": WDConfig,
     "parallel": ParallelConfig,
+    "mesh": MeshConfig,
     "wire": WireConfig,
     "server": ServerConfig,
     "serve": ServeConfig,

@@ -49,6 +49,9 @@ _CONTEXT_EVENTS = frozenset({
     "apply.begin",       # multislice: batch entered the apply engine
     "coord.dead_worker", # coordinator sweep promoted a dead worker
     "heartbeat.beat",    # reporter liveness tick
+    "mesh.apply",        # mesh backend: sharded update dispatched
+    "mesh.pull",         # mesh backend: gather+psum pull issued
+    "mesh.push",         # mesh backend: push payload (bytes post-quant)
     "rpc.conn_died",     # wire: connection death observed
     "rpc.issue",         # client issue side of the (cid, seq) stitch
     "rpc.out",           # frame left the process

@@ -1,0 +1,228 @@
+"""Transport-neutral KV backends (parallel/backend.py + meshbackend.py):
+seed-for-seed parity between the socket wire tier and the in-mesh GSPMD
+tier through ONE canonical trainer loop, the quantized-collective error
+feedback's telescoping identity, table padding on awkward mesh shapes,
+and the flight-recorder coverage of the new path.
+
+The load-bearing parity claim: ``train_linear`` is the SAME client code
+on both backends, so the f32 arms must agree bit-for-bit (same updater
+math, same apply order, no stochastic parts) and the int8 collective arm
+must hold |dAUC| <= 0.002 against f32 — the PR-6 acceptance bound,
+surviving the transport change."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.kv.updaters import Ftrl, Sgd
+from parameter_server_tpu.parallel.backend import (
+    SocketBackend,
+    local_socket_backend,
+    make_backend,
+    train_linear,
+)
+from parameter_server_tpu.parallel.meshbackend import MeshBackend
+from parameter_server_tpu.utils.config import PSConfig
+
+NUM_KEYS = 1 << 12
+
+
+def _updater() -> Ftrl:
+    # alpha/l1 sized for per-example-MEAN gradients (the train_linear
+    # normalization); the default l1=1 would pin every weight at zero
+    return Ftrl(alpha=1.0, beta=1.0, lambda_l1=1e-4)
+
+
+def _workload(seed: int = 3, nnz: int = 16, bsz: int = 512, nb: int = 10):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=NUM_KEYS - 1) * 1.2
+    kb = rng.integers(0, NUM_KEYS - 1, size=(bsz * nb, nnz))
+    logits = w_true[kb].sum(axis=1) / np.sqrt(nnz)
+    y = (rng.random(bsz * nb) < 1 / (1 + np.exp(-logits))).astype(
+        np.float64
+    )
+    return kb, y, bsz
+
+
+def _socket_backend(num_servers: int = 2) -> SocketBackend:
+    return local_socket_backend(_updater, NUM_KEYS, num_servers)
+
+
+class TestBackendParity:
+    def test_f32_socket_and_mesh_agree_exactly(self):
+        """Same FTRL run, same seeds, both transports: the f32 arms have
+        no stochastic parts, so probabilities AND final weights must
+        agree to float tolerance (here: exactly)."""
+        kb, y, bsz = _workload()
+        sb = _socket_backend()
+        try:
+            out_s = train_linear(sb, kb, y, bsz)
+            w_s = sb.weights()
+        finally:
+            sb.close()
+        mb = MeshBackend(_updater(), NUM_KEYS)
+        out_m = train_linear(mb, kb, y, bsz)
+        w_m = mb.weights()
+        np.testing.assert_allclose(
+            out_m["probs"], out_s["probs"], atol=1e-7
+        )
+        np.testing.assert_allclose(w_m, w_s, atol=1e-6)
+        assert out_m["auc"] == pytest.approx(out_s["auc"], abs=1e-9)
+
+    def test_int8_collective_holds_auc_within_pr6_bound(self):
+        """The quantized collective arm (int8 + error feedback) mirrors
+        the PR-6 acceptance: |dAUC| <= 0.002 vs the f32 arm at equal
+        seeds — the int8 win survives the transport change."""
+        kb, y, bsz = _workload(nb=16)
+        auc = {}
+        for quant in ("off", "int8"):
+            mb = MeshBackend(_updater(), NUM_KEYS, quant=quant)
+            auc[quant] = train_linear(mb, kb, y, bsz)["auc"]
+        assert abs(auc["int8"] - auc["off"]) <= 0.002, auc
+        # and the quantized arm genuinely learned (not parity-of-noise)
+        assert auc["int8"] > 0.55
+
+
+class TestMeshBackend:
+    def test_error_feedback_telescopes_exactly(self):
+        """With SGD(eta=1) the table weight is -sum(decoded pushes), and
+        error feedback telescopes: sum(decoded) = sum(true grads) -
+        final residual. Exact equality iff every logical push folded and
+        applied exactly once — a double fold breaks it by a whole
+        quantization step."""
+        rng = np.random.default_rng(7)
+        mb = MeshBackend(Sgd(eta=1.0), 256, quant="int8", quant_seg=32)
+        keys = np.arange(1, 129, dtype=np.int64)
+        total = np.zeros((128, 1), np.float32)
+        for i in range(6):
+            g = (rng.normal(size=(128, 1)) * 0.1).astype(np.float32)
+            total += g
+            mb.push(keys, g)
+        mb.flush()
+        w = mb.weights()[keys.ravel()]
+        res = mb.residual_rows(keys)
+        np.testing.assert_allclose(w, -(total - res), atol=1e-5)
+        assert mb.residual_norm() > 0.0  # int8 really quantized
+
+    def test_padding_arbitrary_num_keys_on_8_wide_kv(self):
+        """A table size that does not divide the kv axis pads up; the
+        pad rows are invisible (weights() trims, top real keys usable)."""
+        mb = MeshBackend(Sgd(eta=0.5), 1001, kv_shards=8)
+        assert mb._rows == 1008 and mb._shard == 126
+        keys = np.array([1, 500, 999, 1000], dtype=np.int64)
+        g = np.ones((4, 1), np.float32)
+        mb.push(keys, g)
+        w = mb.weights()
+        assert w.shape == (1001, 1)
+        np.testing.assert_allclose(w[keys.ravel(), 0], -0.5, atol=1e-6)
+        assert np.count_nonzero(w) == 4
+        np.testing.assert_allclose(mb.pull(keys).ravel(), -0.5, atol=1e-6)
+
+    def test_empty_and_async_paths(self):
+        mb = MeshBackend(Sgd(eta=1.0), 64)
+        assert mb.pull(np.zeros(0, np.int64)).shape == (0, 1)
+        mb.push(np.zeros(0, np.int64), np.zeros((0, 1), np.float32))
+        keys = np.array([3, 9], dtype=np.int64)
+        f = mb.push_async(keys, np.ones(2, np.float32))
+        assert f.result() is None
+        w = mb.pull_async(keys).result()
+        np.testing.assert_allclose(w.ravel(), -1.0, atol=1e-6)
+
+    def test_flightrec_events_cover_the_mesh_path(self, tmp_path):
+        """The new data plane leaves wreckage the postmortem plane can
+        interpret: mesh.pull / mesh.push / mesh.apply ride the ring (and
+        are declared in postmortem._CONTEXT_EVENTS — the
+        flightrec-contract checker pins that both ways)."""
+        from parameter_server_tpu.utils import flightrec
+        from parameter_server_tpu.utils.postmortem import _CONTEXT_EVENTS
+
+        flightrec.configure(
+            str(tmp_path), process_name="test-mesh",
+            flush_interval_s=0, watchdog_interval_s=60,
+        )
+        try:
+            mb = MeshBackend(Sgd(eta=1.0), 64, quant="int8")
+            keys = np.array([1, 2, 3], dtype=np.int64)
+            mb.push(keys, np.ones(3, np.float32))
+            mb.pull(keys)
+            etypes = {e[2] for e in flightrec.events()}
+        finally:
+            flightrec.configure(None)
+        assert {"mesh.push", "mesh.apply", "mesh.pull"} <= etypes
+        assert {"mesh.push", "mesh.apply", "mesh.pull"} <= _CONTEXT_EVENTS
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="quant"):
+            MeshBackend(Sgd(), 64, quant="int4")
+        cfg = PSConfig()
+        cfg.mesh.backend = "bogus"
+        with pytest.raises(ValueError, match="backend"):
+            make_backend(cfg)
+        cfg.mesh.backend = "socket"
+        with pytest.raises(ValueError, match="socket"):
+            make_backend(cfg)  # needs handles + ranges
+
+    def test_make_backend_mesh_from_config(self):
+        cfg = PSConfig()
+        cfg.app = "linear_method"
+        cfg.data.num_keys = 128
+        cfg.mesh.backend = "mesh"
+        cfg.mesh.quant = "int8"
+        cfg.mesh.kv_shards = 4
+        be = make_backend(cfg)
+        assert isinstance(be, MeshBackend)
+        assert be.mesh.shape["kv"] == 4 and be._quant_bytes == 1
+
+
+class TestSocketBackendFanout:
+    def test_flush_raises_fire_and_forget_push_failure(self):
+        """flush() == "durably applied": a push_async whose future nobody
+        retained must still surface its failure at the next flush —
+        failed futures self-removing from the in-flight set must not
+        turn data loss into a clean return. Observed exactly once."""
+        from concurrent.futures import Future
+
+        from parameter_server_tpu.utils.keyrange import KeyRange
+
+        class _BoomHandle:
+            def push_async(self, seg, g):
+                f: Future = Future()
+                f.set_exception(RuntimeError("shard died"))
+                return f
+
+        sb = SocketBackend(
+            [_BoomHandle()], KeyRange(0, 64).even_divide(1), 64,
+            own_handles=False,
+        )
+        sb.push_async(np.array([3], dtype=np.int64), np.ones(1, np.float32))
+        with pytest.raises(RuntimeError, match="shard died"):
+            sb.flush()
+        sb.flush()  # the failure was consumed; the barrier is clean again
+
+    def test_range_fanout_matches_direct_handles(self):
+        """The backend's range slicing must reproduce the hand-rolled
+        fan-out: a pull over keys spanning both shards returns the same
+        rows as per-handle range-relative pulls."""
+        sb = _socket_backend()
+        try:
+            keys = np.array(
+                [1, 7, NUM_KEYS // 2 - 1, NUM_KEYS // 2, NUM_KEYS - 1],
+                dtype=np.int64,
+            )
+            g = np.arange(1, 6, dtype=np.float32)
+            sb.push(keys, g)
+            sb.flush()
+            via_backend = sb.pull(keys).ravel()
+            lo = keys[keys < NUM_KEYS // 2]
+            hi = keys[keys >= NUM_KEYS // 2] - NUM_KEYS // 2
+            direct = np.concatenate([
+                sb.handles[0].pull(lo), sb.handles[1].pull(hi),
+            ])
+            np.testing.assert_allclose(via_backend, direct, atol=0)
+            # and weights() assembles the dumps in range order
+            w = sb.weights()
+            assert w.shape == (NUM_KEYS, 1)
+            assert np.count_nonzero(w) == len(keys)
+        finally:
+            sb.close()

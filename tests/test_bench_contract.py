@@ -58,6 +58,15 @@ def _full(sub_overrides=None, **top):
                        "push_payload_mb_f32": 1.287,
                        "push_payload_mb_int8": 0.327,
                        "residual_peak_x1e6_int8": 4},
+        "backend": {"mesh_vs_socket_push_speedup": 4.2,
+                    "crossover_keys_per_push": 1024,
+                    "quant_bytes_ratio_int8": 3.8,
+                    "auc_delta_int8": 0.0003,
+                    "train_ex_per_sec_socket": 2100.0,
+                    "train_ex_per_sec_mesh": 9300.0,
+                    "train_auc_socket": 0.651,
+                    "train_auc_mesh": 0.651,
+                    "push_sweep": {"u256": {"speedup": 0.7}}},
     }
     sub.update(sub_overrides or {})
     return {
@@ -83,7 +92,7 @@ class TestCompactContract:
             assert k in c, k
         assert set(c["sub"]) >= {"e2e", "ladder", "hbm", "scale", "w2v",
                                  "mf", "darlin", "spmd", "wd", "ingest",
-                                 "rpc", "srv", "quant"}
+                                 "rpc", "srv", "quant", "backend"}
         assert c["sub"]["srv"]["batched_speedup_w8"] == 3.61
         assert c["sub"]["srv"]["hdr_speedup_4k"] == 1.38
 
@@ -96,6 +105,19 @@ class TestCompactContract:
             "auc_delta_int8": 0.0001,
             "holdout_auc_f32": 0.65,
             "holdout_auc_int8": 0.6501,
+        }
+
+    def test_backend_cell_reaches_the_line(self):
+        # the transport-neutral backend's acceptance numbers (ISSUE 11):
+        # mesh-vs-socket push speedup, the crossover point and the
+        # quantized-collective ratios must ride the driver-recorded
+        # stdout line, not just the full file
+        c = bench._compact_contract(_full(), "f.json")
+        assert c["sub"]["backend"] == {
+            "mesh_vs_socket_push_speedup": 4.2,
+            "crossover_keys_per_push": 1024,
+            "quant_bytes_ratio_int8": 3.8,
+            "auc_delta_int8": 0.0003,
         }
 
     def test_telemetry_block_reaches_the_line(self):

@@ -202,12 +202,65 @@ def test_aggregate_traffic_estimate():
         linear_step_traffic(4096, 1, 8, 4, push_mode="aggregate")
 
 
-def test_num_keys_divisibility_enforced():
+def test_num_keys_padded_to_kv_axis():
+    """Arbitrary table sizes on any mesh shape: a num_keys that does not
+    divide the kv axis is padded up to the next multiple (pad rows stay
+    exactly zero — the store's pad-row invariant) and the trained state
+    matches the single-device trajectory on the real rows."""
+    from parameter_server_tpu.kv.store import pull as kv_pull, push as kv_push
+    from parameter_server_tpu.models.linear import batch_to_device
+    from parameter_server_tpu.ops.sparse import csr_grad, csr_logits, logistic_loss
+    from parameter_server_tpu.parallel.spmd import padded_num_keys
+
+    assert padded_num_keys(510, 8) == 512
+    assert padded_num_keys(512, 8) == 512
+    assert padded_num_keys(1, 8) == 8
+    with pytest.raises(ValueError, match="num_keys"):
+        padded_num_keys(0, 8)
+
+    num_keys = 510  # not a multiple of the 8-wide kv axis
+    up = Ftrl(alpha=0.3, lambda_l1=0.1)
     mesh = make_mesh(1, 8)
-    with pytest.raises(ValueError, match="divisible"):
-        make_spmd_train_step(Ftrl(), mesh, 510)
-    with pytest.raises(ValueError, match="divisible"):
-        make_spmd_predict_step(Ftrl(), mesh, 510)
+    labels, keys, vals, _ = make_sparse_logistic(
+        64, num_keys - 2, nnz_per_example=8, seed=3
+    )
+    builder = BatchBuilder(
+        num_keys=num_keys, batch_size=64, max_nnz_per_example=32,
+        key_mode="identity",
+    )
+    b = builder.build(labels, keys, vals)
+
+    state_ref = up.init(num_keys, 1)
+    dev = batch_to_device(b)
+    w_u = kv_pull(up, state_ref, dev["unique_keys"])
+    logits = csr_logits(
+        w_u, dev["values"], dev["local_ids"], dev["row_ids"],
+        num_rows=dev["labels"].shape[0],
+    )
+    _, err = logistic_loss(logits, dev["labels"], dev["example_mask"])
+    g = csr_grad(
+        err, dev["values"], dev["local_ids"], dev["row_ids"],
+        num_unique=dev["unique_keys"].shape[0],
+    )
+    state_ref = kv_push(up, state_ref, dev["unique_keys"], g)
+
+    step = make_spmd_train_step(up, mesh, num_keys)
+    state = shard_state(up.init(num_keys, 1), mesh)
+    state, out = step(state, stack_batches([b], mesh))
+    assert np.isfinite(float(out["loss_sum"]))
+    for key in state_ref:
+        got = np.asarray(state[key])
+        assert got.shape[0] == 512  # padded to the kv multiple
+        np.testing.assert_allclose(
+            got[:num_keys], np.asarray(state_ref[key]), atol=1e-5,
+            err_msg=key,
+        )
+        assert np.all(got[num_keys:] == 0.0)  # pad rows exactly zero
+
+    # predict over the padded table works and matches shapes
+    predict = make_spmd_predict_step(up, mesh, num_keys)
+    p = np.asarray(predict(state, stack_batches([b], mesh)))
+    assert p.shape == (1, 64)
 
 
 def test_make_mesh_too_small():
