@@ -74,10 +74,14 @@ Sub-benches ("sub"):
                  server (the async engine's headline ratio); (3) a
                  4 KiB -> 4 MiB payload sweep reporting MB/s for lockstep
                  vs pipelined through the zero-copy frame path plus a
-                 compressible cell exercising the adaptive-zip probe. Its
-                 process telemetry snapshot is embedded in the full
-                 results as "telemetry", so BENCH_* rounds track RPC
-                 latency alongside throughput.
+                 compressible cell exercising the adaptive-zip probe;
+                 (4) observability overhead guards: flightrec_ratio
+                 (ISSUE 9, armed recorder within 5%) and
+                 observability_ratio (ISSUE 13: flightrec + time-series
+                 rolling + the sampling profiler ALL armed vs all off,
+                 also within 5%). Its process telemetry snapshot is
+                 embedded in the full results as "telemetry", so
+                 BENCH_* rounds track RPC latency alongside throughput.
   server_apply — shard-server batched apply engine A/B on loopback: push
                  throughput at 8 concurrent pipelined clients with the
                  apply engine ON (coalesced, single-dispatch batches)
@@ -1325,6 +1329,43 @@ def child_wire_rpc() -> dict:
         out["flightrec_ratio"] = round(
             stats.median(on / off for off, on in fr_rounds), 3
         )
+
+        # FULL observability overhead guard (ISSUE 13 acceptance: push
+        # throughput with flightrec + time-series rolling + the sampling
+        # profiler ALL armed within 5% of all-off). The roller runs far
+        # above its production cadence (0.1 s vs one roll per heartbeat)
+        # and the profiler at its default Hz, so this is a conservative
+        # ceiling on what a fully-instrumented node pays.
+        from parameter_server_tpu.utils import profiler as prof_mod
+        from parameter_server_tpu.utils import timeseries as ts_mod
+
+        obs_rounds = []
+        for _ in range(5):
+            flightrec.configure(None)
+            prof_mod.configure(0)
+            off = _rps_pipelined(400)
+            flightrec.configure(
+                bb_dir, process_name="bench-wire_rpc",
+                flush_interval_s=0, watchdog_interval_s=60,
+            )
+            prof_mod.configure(prof_mod.DEFAULT_HZ)
+            roller = ts_mod.Roller(0.1)
+            try:
+                on = _rps_pipelined(400)
+            finally:
+                roller.close()
+                prof_mod.configure(0)
+                flightrec.configure(None)
+            obs_rounds.append((off, on))
+        out["push_rps_observability_off"] = round(
+            stats.median(r[0] for r in obs_rounds), 1
+        )
+        out["push_rps_observability_on"] = round(
+            stats.median(r[1] for r in obs_rounds), 1
+        )
+        out["observability_ratio"] = round(
+            stats.median(on / off for off, on in obs_rounds), 3
+        )
         lockstep.close()
         pipelined.close()
     finally:
@@ -2434,10 +2475,12 @@ def _compact_contract(full: dict, full_ref: str) -> dict:
             # the telemetry block: RPC latency + the pipelined wire's
             # headline ratios reach the driver-recorded line, not just
             # the full results file
+            # observability_ratio (ISSUE 13 acceptance): push rps with
+            # flightrec + timeseries + profiler all armed vs all off
             "rpc": _pick(
                 "wire_rpc", "roundtrips_per_sec", "pull_p50_ms",
                 "push_p99_ms", "pipelined_speedup_w8",
-                "mb_s_1mib_pipelined"),
+                "mb_s_1mib_pipelined", "observability_ratio"),
             # the batched apply engine's acceptance ratios (ISSUE 4):
             # batched-vs-serial push throughput at 8 pipelined clients
             # and binary-vs-JSON header rps at 4 KiB frames

@@ -155,6 +155,28 @@ def _build_parser() -> argparse.ArgumentParser:
         "--scheduler", required=True, help="coordinator host:port"
     )
 
+    tp = sub.add_parser(
+        "top",
+        help="live cluster dashboard (the operations plane's `top`): "
+        "auto-refreshing per-node windowed rates + p99 latencies from "
+        "the coordinator's retained heartbeat time series, SLO "
+        "burn-rate health per node, active alerts and hot keys",
+    )
+    tp.add_argument("--scheduler", required=True, help="coordinator host:port")
+    tp.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh cadence in seconds",
+    )
+    tp.add_argument(
+        "--window", type=float, default=0.0,
+        help="rate/percentile window in seconds (0 = the coordinator's "
+        "[timeseries] window_s default)",
+    )
+    tp.add_argument(
+        "--once", action="store_true",
+        help="print one frame and exit (scripts / tests)",
+    )
+
     pm = sub.add_parser(
         "postmortem",
         help="merge the black-box dumps of a crashed/stalled cluster "
@@ -753,6 +775,40 @@ def run_stats(args: argparse.Namespace) -> dict:
     }
 
 
+def run_top(args: argparse.Namespace) -> int:
+    """The auto-refreshing live dashboard (``cli top``): query the
+    coordinator's ``telemetry`` command (windowed per-node series + SLO
+    verdict) and render a frame every ``--interval``; ``--once`` prints
+    a single frame for scripts and tests."""
+    import time as time_mod
+
+    from parameter_server_tpu.parallel.control import ControlClient
+    from parameter_server_tpu.utils.slo import format_top
+
+    ctl = ControlClient(args.scheduler, retries=5, reconnect_timeout_s=5.0)
+    window = args.window or None
+    try:
+        while True:
+            rep = ctl.telemetry(window_s=window)
+            shown_window = (
+                args.window
+                or next(iter(rep.get("series", {}).values()), {}).get(
+                    "window_s", 0.0
+                )
+            )
+            frame = format_top(rep, float(shown_window or 0.0))
+            if args.once:
+                print(frame)
+                return 0
+            # ANSI home+clear: the `top` idiom — repaint in place
+            print("\x1b[2J\x1b[H" + frame, flush=True)
+            time_mod.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        ctl.close()
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.cmd == "lint":
@@ -851,6 +907,9 @@ def main(argv: list[str] | None = None) -> int:
         # no config file: stats only needs a live coordinator address
         print(json.dumps(run_stats(args), default=float))
         return 0
+    if args.cmd == "top":
+        # no config file: the dashboard reads the live coordinator
+        return run_top(args)
     if args.cmd == "postmortem":
         # no config file: a postmortem works from the dumps alone
         from parameter_server_tpu.utils.postmortem import postmortem
@@ -865,45 +924,94 @@ def main(argv: list[str] | None = None) -> int:
         # flag wins over both the config and the ambient env; run_node /
         # PodTrainer re-arm with a role-specific process name from cfg
         cfg.trace.trace_dir = args.trace_dir
-    if args.cmd == "train" and cfg.trace.trace_dir:
-        from parameter_server_tpu.utils import trace
-
-        trace.configure(
-            cfg.trace.trace_dir, capacity=cfg.trace.capacity,
-            process_name="train",
-        )
+    msrv = roller = None
+    armed_prof = False
     if args.cmd == "train":
-        out = run_train(cfg, args)
-    elif args.cmd == "backend":
-        out = run_backend(cfg, args)
-    elif args.cmd == "evaluate":
-        out = run_evaluate(cfg, args)
-    elif args.cmd == "convert":
-        out = run_convert(cfg, args)
-    elif args.cmd == "node":
-        from parameter_server_tpu.parallel.multislice import run_node
+        if cfg.trace.trace_dir:
+            from parameter_server_tpu.utils import trace
 
-        if args.fault_plan:
-            # flag wins over both the ambient env and the config file; the
-            # cfg field carries it into every RpcServer this node builds
-            cfg.fault.fault_plan = args.fault_plan
-            cfg.fault.fault_seed = args.fault_seed
-        out = run_node(
-            cfg, args.role, args.rank, args.scheduler,
-            args.num_servers, args.num_workers, args.model_out,
-            bind_host=args.bind_host, advertise_host=args.advertise_host,
-            ckpt_dir=args.ckpt_dir,
-        )
-        if out is None:  # servers/workers exit silently; scheduler reports
-            return 0
-    else:
-        from parameter_server_tpu.parallel.multislice import launch_local
+            trace.configure(
+                cfg.trace.trace_dir, capacity=cfg.trace.capacity,
+                process_name="train",
+            )
+        # live-ops arming for the single-process train path (spawned
+        # node roles arm in run_node with role-rank names): continuous
+        # profiler from [profile]/PS_PROFILE; OpenMetrics endpoint from
+        # [timeseries], with a Roller thread feeding the local ring at
+        # heartbeat cadence (no beats feed it here) so /healthz serves
+        # a live windowed summary
+        from parameter_server_tpu.utils import profiler, timeseries
 
-        out = launch_local(
-            args.app_file, args.num_servers, args.num_workers, args.model_out,
-            fault_plan=args.fault_plan, fault_seed=args.fault_seed,
-            trace_dir=args.trace_dir, blackbox_dir=args.blackbox_dir,
+        hz = cfg.profile.hz if cfg.profile.hz > 0 else profiler.env_hz()
+        if hz > 0:
+            profiler.configure(
+                hz, top_n=cfg.profile.top_n,
+                max_depth=cfg.profile.max_depth,
+                dump_dir=cfg.profile.dump_dir, process_name="train",
+            )
+            armed_prof = True
+        # same port resolution as run_node: the config wins, then the
+        # inherited PS_METRICS_PORT (the documented env arming path)
+        import os as os_mod
+
+        mport = cfg.timeseries.metrics_port or int(
+            os_mod.environ.get(timeseries.METRICS_PORT_ENV, "0") or 0
         )
+        if mport > 0:
+            timeseries.reset_local_ring(cfg.timeseries.capacity)
+            msrv = timeseries.start_metrics_server(
+                mport, process_name="train",
+                host=cfg.timeseries.metrics_host,
+                window_s=cfg.timeseries.window_s,
+            )
+            roller = timeseries.Roller(cfg.fault.heartbeat_interval_s)
+    try:
+        if args.cmd == "train":
+            out = run_train(cfg, args)
+        elif args.cmd == "backend":
+            out = run_backend(cfg, args)
+        elif args.cmd == "evaluate":
+            out = run_evaluate(cfg, args)
+        elif args.cmd == "convert":
+            out = run_convert(cfg, args)
+        elif args.cmd == "node":
+            from parameter_server_tpu.parallel.multislice import run_node
+
+            if args.fault_plan:
+                # flag wins over both the ambient env and the config
+                # file; the cfg field carries it into every RpcServer
+                # this node builds
+                cfg.fault.fault_plan = args.fault_plan
+                cfg.fault.fault_seed = args.fault_seed
+            out = run_node(
+                cfg, args.role, args.rank, args.scheduler,
+                args.num_servers, args.num_workers, args.model_out,
+                bind_host=args.bind_host, advertise_host=args.advertise_host,
+                ckpt_dir=args.ckpt_dir,
+            )
+            if out is None:  # servers/workers exit silently; scheduler reports
+                return 0
+        else:
+            from parameter_server_tpu.parallel.multislice import launch_local
+
+            out = launch_local(
+                args.app_file, args.num_servers, args.num_workers,
+                args.model_out,
+                fault_plan=args.fault_plan, fault_seed=args.fault_seed,
+                trace_dir=args.trace_dir, blackbox_dir=args.blackbox_dir,
+            )
+    finally:
+        # an in-process caller (tests) must not leak the HTTP server,
+        # the roll thread or a still-sampling profiler past main()
+        # (disarming the profiler also writes its configured dumps)
+        if roller is not None:
+            roller.close()
+        if msrv is not None:
+            msrv.close()
+        if armed_prof:
+            from parameter_server_tpu.utils import profiler
+
+            profiler.configure(0)
     print(json.dumps(out, default=float))
     return 0
 

@@ -1864,14 +1864,43 @@ class Coordinator:
         heartbeat_timeout_s: float = 30.0,
         recovery_interval_s: float = 0.0,
         fault_plan: FaultPlan | None = None,
+        slo_cfg: "SloConfig | None" = None,
+        series_capacity: int = 360,
+        series_window_s: float = 60.0,
     ):
+        from parameter_server_tpu.utils.config import SloConfig
+        from parameter_server_tpu.utils.slo import SloEngine, parse_rules
+
         self._nodes: dict[int, dict[str, Any]] = {}
         self._next_id = 0
         self._barriers: dict[str, list[int]] = {}  # name -> [arrived, generation]
         self._kv: dict[str, tuple[dict, Arrays]] = {}
         self._pool: WorkloadPool | None = None
         self._progress: dict[int, dict[str, Any]] = {}
-        self._monitor = HeartbeatMonitor(heartbeat_timeout_s)
+        self._monitor = HeartbeatMonitor(
+            heartbeat_timeout_s, series_capacity=series_capacity
+        )
+        # the live-ops plane (ISSUE 13): per-node telemetry history is
+        # retained by the monitor; this engine turns it into multi-window
+        # burn-rate alerts, evaluated on every recovery sweep (alerts
+        # fire with no viewer attached) and on every telemetry query
+        scfg = slo_cfg or SloConfig()
+        self._slo = SloEngine(
+            parse_rules(scfg.rules),
+            short_window_s=scfg.short_window_s,
+            long_window_s=scfg.long_window_s,
+        )
+        self._series_window_s = series_window_s
+        # the scheduler process never heartbeats to itself, but it OWNS
+        # cluster-level signals (the SSP clock's ssp_blocked_ms, control
+        # dedup/recovery counters) — without its own ring the shipped
+        # ssp_blocked_ms SLO rule could never see data. Fed by
+        # _observe_self() on every sweep/telemetry pass, rate-limited so
+        # a polling dashboard can't flood it with sub-second entries.
+        from parameter_server_tpu.utils.timeseries import TimeSeriesRing
+
+        self._self_ring = TimeSeriesRing(series_capacity)
+        self._self_last = 0.0
         self._clock: SSPClock | None = None
         self._cv = threading.Condition()
         # batched beat/progress ingestion (ROADMAP carry-over): these
@@ -1923,8 +1952,26 @@ class Coordinator:
         self._sweep_thread = threading.Thread(target=sweep, daemon=True)
         self._sweep_thread.start()
 
+    def _observe_self(self) -> None:
+        """Roll the coordinator's own telemetry into its ring (at most
+        ~4x/second however often sweeps and dashboards ask)."""
+        now = time.time()
+        if now - self._self_last < 0.25:
+            return
+        self._self_last = now
+        self._self_ring.observe(
+            telemetry_snapshot(roll_peaks=False), ts=now
+        )
+
+    def _slo_rings(self) -> dict[Any, Any]:
+        return {**self._monitor.node_series(), "coord": self._self_ring}
+
     def _sweep_once(self) -> None:
         self._drain_ingest(wait=True)  # a queued beat must not read dead
+        # SLO pass rides the sweep cadence: alerts must fire (and land in
+        # the flight recorder) even when nobody is watching `cli top`
+        self._observe_self()
+        self._slo.evaluate(self._slo_rings())
         for nid in self._monitor.dead():
             with self._cv:
                 info = dict(self._nodes.get(nid, {}))
@@ -2142,11 +2189,24 @@ class Coordinator:
             if tel:
                 node_snaps.append(tel)
         local = telemetry_snapshot()  # the coordinator's own process
+        # the live-ops view (ISSUE 13): per-node windowed rates/p50/p99
+        # from the retained beat history + the SLO engine's verdict
+        # ("coord" is the scheduler process itself — SSP blocked time
+        # and control-plane counters live only there)
+        self._observe_self()
+        window_s = float(h.get("window_s") or self._series_window_s)
+        rings = self._slo_rings()
+        series = {
+            str(nid): ring.summary(window_s)
+            for nid, ring in rings.items()
+        }
         return {
             "ok": True,
             "nodes": per_node,
             "coordinator": local,
             "merged": merge_telemetry(node_snaps + [local]),
+            "series": series,
+            "slo": self._slo.evaluate(rings),
         }, {}
 
     def _cmd_dead(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
@@ -2278,11 +2338,18 @@ class ControlClient(RpcClient):
     def beat(self, node_id: int, stats: dict | None = None) -> None:
         self.call("beat", node_id=node_id, stats=stats)
 
-    def telemetry(self) -> dict[str, Any]:
+    def telemetry(self, window_s: float | None = None) -> dict[str, Any]:
         """Cluster telemetry: per-node snapshots + the merged view
-        (counters summed, latency histograms merged bucket-wise)."""
-        rep, _ = self.call("telemetry")
-        return {k: rep[k] for k in ("nodes", "coordinator", "merged")}
+        (counters summed, latency histograms merged bucket-wise), plus
+        the live-ops blocks — per-node windowed ``series`` summaries
+        over ``window_s`` (the coordinator's default when None) and the
+        ``slo`` engine's health/alert verdict."""
+        rep, _ = self.call("telemetry", window_s=window_s)
+        return {
+            k: rep[k]
+            for k in ("nodes", "coordinator", "merged", "series", "slo")
+            if k in rep
+        }
 
     def ssp_init(self, num_workers: int, max_delay: int) -> None:
         self.call("ssp_init", num_workers=num_workers, max_delay=max_delay)

@@ -2040,7 +2040,12 @@ class _Beats:
         self._sink = _RemoteBeatSink(scheduler)
 
         def beat_stats() -> dict:
-            return {**host_stats(), "telemetry": telemetry_snapshot()}
+            # ONE snapshot serves three planes (ISSUE 13): the beat
+            # piggyback, this node's local time-series ring roll, and
+            # the heartbeat payload guard's saturation caps
+            from parameter_server_tpu.utils.timeseries import beat_telemetry
+
+            return {**host_stats(), "telemetry": beat_telemetry()}
 
         self._rep = HeartbeatReporter(
             self._sink, node_id, interval_s, stats_fn=beat_stats
@@ -2709,6 +2714,18 @@ def run_node(
     """Role dispatch for one spawned process (ref: App::Create + main.cc)."""
     import os
 
+    # the ONE unknown-role gate, before ANY arming side effects (an
+    # armed tracer/recorder/profiler named after a typo'd role, or a
+    # KeyError out of the metrics-port table, are worse diagnostics);
+    # the table doubles as the metrics-endpoint port layout below
+    metrics_offset = {
+        "scheduler": 0,
+        "server": 1 + rank,
+        "worker": 1 + num_servers + rank,
+    }.get(role)
+    if metrics_offset is None:
+        raise ValueError(f"unknown role {role!r}")
+
     # arm tracing for this node: config [trace] trace_dir wins, then the
     # inherited PS_TRACE_DIR env (launch_local's arming path); the process
     # name makes each node's export file self-describing
@@ -2736,21 +2753,64 @@ def run_node(
             watchdog_interval_s=cfg.blackbox.watchdog_interval_s,
             stall_timeout_s=cfg.blackbox.stall_timeout_s,
         )
-    if role == "scheduler":
-        host, port = scheduler.rsplit(":", 1)
-        coord = Coordinator(
-            host, int(port), heartbeat_timeout_s=cfg.fault.heartbeat_timeout_s,
-            fault_plan=_plan_from_cfg(cfg),
+    # arm the continuous profiler: config [profile] hz wins, then the
+    # inherited PS_PROFILE (env-armed at import; re-configured here so
+    # the dump carries a role-rank name) — ISSUE 13
+    from parameter_server_tpu.utils import profiler, timeseries
+
+    prof_hz = cfg.profile.hz if cfg.profile.hz > 0 else profiler.env_hz()
+    if prof_hz > 0:
+        profiler.configure(
+            prof_hz, top_n=cfg.profile.top_n,
+            max_depth=cfg.profile.max_depth,
+            dump_dir=cfg.profile.dump_dir
+            or os.environ.get(profiler.PROFILE_DIR_ENV, ""),
+            process_name=f"{role}-{rank}",
         )
-        return run_scheduler(cfg, coord, num_servers, num_workers, model_out)
-    if role == "server":
-        run_server(
-            cfg, scheduler, rank, num_servers,
-            bind_host=bind_host, advertise_host=advertise_host,
-            ckpt_dir=ckpt_dir,
+    # OpenMetrics scrape endpoint: [timeseries] metrics_port (or the
+    # inherited PS_METRICS_PORT) is the BASE port; each role-rank binds
+    # a deterministic offset so one host's processes never collide
+    mbase = cfg.timeseries.metrics_port or int(
+        os.environ.get(timeseries.METRICS_PORT_ENV, "0") or 0
+    )
+    # size this node's local delta ring (fed by each beat's
+    # beat_telemetry roll; served windowed by /healthz)
+    timeseries.reset_local_ring(cfg.timeseries.capacity)
+    msrv = roller = None
+    if mbase > 0:
+        msrv = timeseries.start_metrics_server(
+            mbase + metrics_offset, process_name=f"{role}-{rank}",
+            host=cfg.timeseries.metrics_host,
+            window_s=cfg.timeseries.window_s,
         )
-        return None
-    if role == "worker":
+        if role == "scheduler":
+            # servers/workers roll the local ring on every beat; the
+            # scheduler never beats, so without this its /healthz
+            # window would stay empty forever and read as a wedged node
+            roller = timeseries.Roller(cfg.fault.heartbeat_interval_s)
+    try:
+        if role == "scheduler":
+            host, port = scheduler.rsplit(":", 1)
+            coord = Coordinator(
+                host, int(port),
+                heartbeat_timeout_s=cfg.fault.heartbeat_timeout_s,
+                fault_plan=_plan_from_cfg(cfg),
+                slo_cfg=cfg.slo,
+                series_capacity=cfg.timeseries.capacity,
+                series_window_s=cfg.timeseries.window_s,
+            )
+            return run_scheduler(cfg, coord, num_servers, num_workers, model_out)
+        if role == "server":
+            run_server(
+                cfg, scheduler, rank, num_servers,
+                bind_host=bind_host, advertise_host=advertise_host,
+                ckpt_dir=ckpt_dir,
+            )
+            return None
         run_worker(cfg, scheduler, rank, num_servers)
         return None
-    raise ValueError(f"unknown role {role!r}")
+    finally:
+        if roller is not None:
+            roller.close()
+        if msrv is not None:
+            msrv.close()

@@ -22,6 +22,7 @@ from collections.abc import Callable
 from typing import Any
 
 from parameter_server_tpu.utils import flightrec
+from parameter_server_tpu.utils.metrics import wire_counters
 
 
 class DispatchWindow:
@@ -144,6 +145,12 @@ class SSPClock:
         # step's 40 ms go" — the SSP gate is one of the places)
         self._blocked_s = [0.0] * num_workers
         self._blocked_n = [0] * num_workers
+        # live-ops counter bookkeeping: ssp_blocked_ms is an int counter
+        # but individual waits are often sub-millisecond — flooring per
+        # event would systematically book 0 and silence the shipped
+        # ssp_blocked_ms SLO rule. Book the whole-ms difference against
+        # the running float total instead (cumulative error < 1 ms).
+        self._blocked_ms_booked = 0
         # watchdog feed: workers currently parked on the gate, and a
         # movement counter every finish/retire advances — "busy with no
         # progress" is exactly a wedged clock
@@ -185,6 +192,15 @@ class SSPClock:
             blocked = time.perf_counter() - t0
             self._blocked_s[worker] += blocked
             self._blocked_n[worker] += 1
+            whole_ms = (
+                int(sum(self._blocked_s) * 1e3) - self._blocked_ms_booked
+            )
+            self._blocked_ms_booked += whole_ms
+        # live-ops signal (ISSUE 13): blocked time as a counter, so the
+        # coordinator's time-series ring exposes a cluster-visible
+        # "ms blocked per second" rate the [slo] engine alerts on
+        if whole_ms > 0:
+            wire_counters.inc("ssp_blocked_ms", whole_ms)
         flightrec.record(
             "ssp.wait", worker=worker, step=step,
             blocked_ms=round(blocked * 1e3, 3), granted=ok,
@@ -248,7 +264,10 @@ class SSPClock:
             self._finished = list(d["finished"])
             self.max_delay = d["max_delay"]
             # blocked-time telemetry is per-process, not model state:
-            # restart it with the restored worker count
+            # restart it with the restored worker count (the counter
+            # bookkeeping restarts with it, or whole-ms deltas would go
+            # negative against the zeroed totals and stall the counter)
             self._blocked_s = [0.0] * len(self._finished)
             self._blocked_n = [0] * len(self._finished)
+            self._blocked_ms_booked = 0
             self._cv.notify_all()

@@ -411,6 +411,82 @@ class BlackboxConfig:
 
 
 @dataclass
+class TimeseriesConfig:
+    """Live cluster time series (utils/timeseries.py): every node keeps a
+    bounded ring of timestamped telemetry DELTAS (counter rates + exact
+    bucket-wise histogram deltas -> windowed p50/p99), fed from the same
+    ``telemetry_snapshot()`` roll the heartbeats piggyback; the
+    coordinator retains each node's beat stream in its own ring, which is
+    what ``cli top`` and the ``[slo]`` burn-rate engine read."""
+
+    # ring entries retained per node (~30 min of history at the default
+    # 5 s heartbeat cadence)
+    capacity: int = 360
+    # default dashboard window (cli top / the telemetry command's
+    # windowed rates + percentiles)
+    window_s: float = 60.0
+    # OpenMetrics scrape endpoint (/metrics + /healthz, stdlib HTTP):
+    # 0 disables; > 0 is the BASE port — the scheduler binds it exactly,
+    # server rank r binds base+1+r, worker rank r binds
+    # base+1+num_servers+r, so one host's processes never collide. The
+    # PS_METRICS_PORT env var arms processes the config never reaches.
+    metrics_port: int = 0
+    # scrape bind address: the loopback default only serves same-host
+    # scrapers; set "0.0.0.0" for an off-host Prometheus (the endpoint
+    # is unauthenticated read-only telemetry — bind wide deliberately)
+    metrics_host: str = "127.0.0.1"
+
+
+@dataclass
+class ProfileConfig:
+    """Continuous sampling profiler (utils/profiler.py): a daemon thread
+    samples ``sys._current_frames()`` at ``hz``, folds stacks, and the
+    top-N hot stacks ride the heartbeat telemetry piggyback. Disarmed
+    (hz=0) it follows the flightrec discipline: the module-level
+    ``top_stacks`` is an identity-pinned no-op and no thread exists.
+    The ``PS_PROFILE`` env var (a rate in Hz, or 1/true/on for the
+    default rate) arms processes the config never reaches."""
+
+    hz: float = 0.0  # sampling rate; 0 = profiler off
+    top_n: int = 5  # hot stacks piggybacked per heartbeat
+    max_depth: int = 24  # frames kept per folded stack
+    # write prof-<name>-<pid>.collapsed (flamegraph/speedscope input) and
+    # a Perfetto-loadable .trace.json here at process exit / dump()
+    dump_dir: str = ""
+
+
+@dataclass
+class SloConfig:
+    """Declarative SLO rules (utils/slo.py), evaluated as multi-window
+    burn rates over each node's time-series ring at the coordinator.
+
+    Rule grammar, one string per rule::
+
+        <name> <kind>:<series> <= <threshold> [target <frac>] [burn <x>]
+
+    ``kind`` is ``rate`` (counter delta per second), ``p50`` or ``p99``
+    (windowed histogram percentile — milliseconds for latency series,
+    raw values for ``.n`` count series). A window's error budget is
+    ``1 - target`` (default 0.99); an alert fires when the budget burns
+    at >= ``burn``x (default 10) over BOTH the short and the long
+    window, once per episode (it re-arms only after both windows
+    recover). ``replication_lag_s`` is declared but has no emitter yet —
+    it is the reserved health signal for chain replication (ROADMAP
+    direction #1); a series with no data never burns."""
+
+    rules: list[str] = field(default_factory=lambda: [
+        "push_p99_ms p99:server.push <= 250",
+        "shed_rate rate:serve_shed <= 10",
+        "stall_count rate:watchdog_stalls <= 0",
+        "ssp_blocked_ms rate:ssp_blocked_ms <= 500",
+        "apply_queue_depth p99:server.apply_queue.n <= 192",
+        "replication_lag_s p99:replication_lag_s <= 1",
+    ])
+    short_window_s: float = 60.0
+    long_window_s: float = 300.0
+
+
+@dataclass
 class PSConfig:
     """Top-level app config (ref: linear_method.proto LinearMethodConfig)."""
 
@@ -433,6 +509,9 @@ class PSConfig:
     fault: FaultConfig = field(default_factory=FaultConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     blackbox: BlackboxConfig = field(default_factory=BlackboxConfig)
+    timeseries: TimeseriesConfig = field(default_factory=TimeseriesConfig)
+    profile: ProfileConfig = field(default_factory=ProfileConfig)
+    slo: SloConfig = field(default_factory=SloConfig)
     model_output: str = ""
     report_interval: int = 1  # progress print cadence, in reports (ref gflag)
     seed: int = 0
@@ -480,6 +559,9 @@ _NESTED = {
     "fault": FaultConfig,
     "trace": TraceConfig,
     "blackbox": BlackboxConfig,
+    "timeseries": TimeseriesConfig,
+    "profile": ProfileConfig,
+    "slo": SloConfig,
 }
 
 

@@ -39,11 +39,22 @@ def host_stats() -> dict:
 
 
 class HeartbeatMonitor:
-    """Scheduler-side registry of last-seen beats (thread-safe)."""
+    """Scheduler-side registry of last-seen beats (thread-safe).
 
-    def __init__(self, timeout_s: float = 30.0):
+    Beyond the latest beat, the monitor RETAINS each node's telemetry
+    stream (ISSUE 13): every piggybacked ``telemetry`` snapshot feeds a
+    per-node bounded :class:`~parameter_server_tpu.utils.timeseries.
+    TimeSeriesRing` of deltas stamped at receive time (receive-time
+    stamping is clock-skew-proof; the beat cadence bounds the error).
+    That history — not the point sample — is what the coordinator's
+    windowed ``telemetry`` view, ``cli top`` and the ``[slo]`` burn-rate
+    engine read."""
+
+    def __init__(self, timeout_s: float = 30.0, series_capacity: int = 360):
         self.timeout_s = timeout_s
+        self.series_capacity = series_capacity
         self._beats: dict[int, dict] = {}
+        self._series: dict[int, "TimeSeriesRing"] = {}
         self._lock = threading.Lock()
 
     def beat(self, node_id: int, stats: dict | None = None) -> None:
@@ -54,10 +65,36 @@ class HeartbeatMonitor:
         coordinator's batched ingest drain): at cluster scale the beat
         stream is the monitor's hottest writer, and per-frame acquires
         made it contend with every dead()/alive() sweep."""
+        from parameter_server_tpu.utils.timeseries import TimeSeriesRing
+
         now = time.monotonic()
+        wall = time.time()
+        feeds: list[tuple["TimeSeriesRing", dict]] = []
         with self._lock:
             for node_id, stats in items:
                 self._beats[node_id] = {"t": now, "stats": stats or {}}
+                tel = (stats or {}).get("telemetry")
+                if tel:
+                    ring = self._series.get(node_id)
+                    if ring is None:
+                        ring = self._series[node_id] = TimeSeriesRing(
+                            self.series_capacity
+                        )
+                    feeds.append((ring, tel))
+        # delta computation (O(series) dict diffing per beat) happens
+        # OUTSIDE the monitor lock — the beat stream is this lock's
+        # hottest writer and must not serialize against dead()/alive()
+        # sweeps. Rings lock themselves; a racing out-of-order observe
+        # is discarded by the ring's monotonic-ts check (beats are
+        # last-writer-wins telemetry).
+        for ring, tel in feeds:
+            ring.observe(tel, ts=wall)
+
+    def node_series(self) -> dict[int, "TimeSeriesRing"]:
+        """Per-node retained telemetry rings (live references — ring
+        reads are internally thread-safe)."""
+        with self._lock:
+            return dict(self._series)
 
     def alive(self) -> list[int]:
         now = time.monotonic()
@@ -89,6 +126,7 @@ class HeartbeatMonitor:
         node simply re-registers it."""
         with self._lock:
             self._beats.pop(node_id, None)
+            self._series.pop(node_id, None)
 
     def dashboard(self) -> str:
         """The scheduler's cluster table (ref: dashboard printout)."""

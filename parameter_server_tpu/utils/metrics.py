@@ -487,6 +487,17 @@ def heat_top(snap: dict[str, Any], k: int = 10) -> list[tuple[int, int]]:
     return pairs[:k]
 
 
+def _profiler_top() -> list[dict[str, Any]] | None:
+    """The continuous profiler's top-N hot stacks IF it is armed
+    (utils/profiler.py) — resolved through ``sys.modules`` like
+    ``race_track``, so an unprofiled process never imports the profiler
+    and the disarmed cost is one dict lookup per snapshot."""
+    pm = sys.modules.get("parameter_server_tpu.utils.profiler")
+    if pm is not None and pm.enabled():
+        return pm.top_stacks()
+    return None
+
+
 def telemetry_snapshot(roll_peaks: bool = True) -> dict[str, Any]:
     """This process's full telemetry state — counters, per-command
     latency histograms, named timers, per-key heat. Small (sparse
@@ -505,6 +516,9 @@ def telemetry_snapshot(roll_peaks: bool = True) -> dict[str, Any]:
     heat = key_heat.snapshot()
     if heat:
         out["key_heat"] = heat
+    prof = _profiler_top()
+    if prof:
+        out["prof"] = prof
     return out
 
 
@@ -517,6 +531,7 @@ def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
     hists: dict[str, list[dict]] = {}
     tmr: dict[str, dict[str, float]] = {}
     heat: list[dict[str, Any]] = []
+    prof: dict[str, int] = {}
     for s in snaps:
         for k, v in s.get("counters", {}).items():
             if k.endswith("_peak"):
@@ -531,6 +546,9 @@ def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
             t["count"] += v.get("count", 0)
         if s.get("key_heat"):
             heat.append(s["key_heat"])
+        for p in s.get("prof") or ():
+            stack = str(p.get("s", ""))
+            prof[stack] = prof.get(stack, 0) + int(p.get("n", 0))
     out = {
         "counters": counters,
         "hists": {k: merge_hist_snapshots(v) for k, v in hists.items()},
@@ -538,6 +556,11 @@ def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
     }
     if heat:
         out["key_heat"] = merge_heat_snapshots(heat)
+    if prof:
+        # cluster-wide hot stacks: sum per folded stack, keep a bounded
+        # heaviest-first list (each node's block is already top-N)
+        ranked = sorted(prof.items(), key=lambda kv: -kv[1])[:20]
+        out["prof"] = [{"s": s, "n": n} for s, n in ranked]
     return out
 
 

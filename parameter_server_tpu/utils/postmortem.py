@@ -52,6 +52,7 @@ _CONTEXT_EVENTS = frozenset({
     "mesh.apply",        # mesh backend: sharded update dispatched
     "mesh.pull",         # mesh backend: gather+psum pull issued
     "mesh.push",         # mesh backend: push payload (bytes post-quant)
+    "prof.dump",         # continuous profiler wrote its exports
     "rpc.conn_died",     # wire: connection death observed
     "rpc.issue",         # client issue side of the (cid, seq) stitch
     "rpc.out",           # frame left the process
@@ -62,6 +63,7 @@ _CONTEXT_EVENTS = frozenset({
     "step.dispatch",     # trainer step anatomy
     "step.retire",
     "thread.exception",  # threading.excepthook crash hook fired
+    "ts.roll",           # local time-series ring rolled a delta
     "watchdog.stall",    # stall firing (the dump's stalls list is the
                          # detector's source; the event is context)
 })
@@ -73,6 +75,7 @@ _CONTEXT_EVENTS = frozenset({
 _DETECTOR_EVENTS = frozenset({
     "rpc.in", "rpc.reply", "apply.commit", "apply.replay", "rcu.publish",
     "rpc.heal.begin", "rpc.healed", "rpc.heal.failed", "serve.shed",
+    "slo.alert",
 })
 
 
@@ -309,6 +312,22 @@ def find_anomalies(
                 "proc": proc, "pid": pid,
                 "begun": begun, "healed": healed,
                 "failed": c.get("rpc.heal.failed", 0),
+            })
+
+    # SLO alerts (ISSUE 13): the coordinator's burn-rate engine fired —
+    # each rising edge is one episode, rendered with its burn multiples
+    # so the postmortem reads "which objective was burning, how hard"
+    for ev in timeline:
+        if ev["etype"] == "slo.alert":
+            a = ev["args"]
+            out.append({
+                "kind": "slo-alert",
+                "proc": ev["proc"],
+                "rule": a.get("rule"),
+                "node": a.get("node"),
+                "burn_short": a.get("burn_short"),
+                "burn_long": a.get("burn_long"),
+                "ts": ev["ts"],
             })
 
     # shed storms: admission control firing in bursts — readers were

@@ -1,0 +1,540 @@
+"""Live cluster time series: bounded rings of telemetry DELTAS.
+
+Reference analog: none — the reference's scheduler dashboard printed the
+*latest* heartbeat and nothing else, so "is the shed rate rising?" was
+unanswerable while the cluster ran. This module is the missing axis:
+every process's cumulative telemetry (``metrics.telemetry_snapshot()``
+— monotonic counters + log2-bucketed latency histograms) is observed
+into a :class:`TimeSeriesRing`, which stores the timestamped DELTA since
+the previous observation. Deltas make the history composable:
+
+- a counter delta over ``dt`` seconds is an exact windowed **rate**;
+- a histogram delta is an exact bucket-wise difference, so a window's
+  **p50/p99** comes from summing the window's delta buckets and reading
+  the percentile — no quantile averaging, the same discipline as the
+  PR-2 cluster merge;
+- ``*_peak`` gauges (already rolled per heartbeat window upstream) ride
+  each entry as-is and merge as a max.
+
+Fed from two sides (ISSUE 13): **client-side**, every node rolls its own
+ring from the same ``telemetry_snapshot()`` call its heartbeat
+piggybacks (``local_roll``); **cluster-side**, ``HeartbeatMonitor``
+retains each node's beat stream in a per-node ring instead of
+overwriting the last beat — the feed for the coordinator ``telemetry``
+command's windowed view, ``cli top`` and the ``[slo]`` burn-rate engine
+(utils/slo.py).
+
+The **OpenMetrics endpoint** (``start_metrics_server``) serves this
+process's cumulative telemetry at ``/metrics`` (strict OpenMetrics text:
+counters with ``_total``, log2 histograms with cumulative ``le``
+buckets, ``# EOF`` terminator) plus a ``/healthz`` liveness probe, over
+a stdlib ``ThreadingHTTPServer`` — an external Prometheus can scrape
+any node with zero dependencies.
+
+The **heartbeat payload guard** rides here too: ``beat_telemetry()`` is
+what ``_Beats`` actually piggybacks — the full snapshot saturates to
+summaries once it outgrows the per-beat budget (the
+``KeyHeatSketch._SNAP_MAX_NNZ`` discipline), so a long run's beat stays
+bounded no matter how many histogram series or profiler stacks the
+process accumulates.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from parameter_server_tpu.utils import flightrec
+from parameter_server_tpu.utils.metrics import (
+    hist_percentile,
+    merge_hist_snapshots,
+    telemetry_snapshot,
+    wire_counters,
+)
+
+METRICS_PORT_ENV = "PS_METRICS_PORT"
+
+#: heartbeat payload guard (ISSUE 13 satellite): a beat's telemetry
+#: block keeps at most this many histogram series — beyond it, the
+#: largest-count series survive and the rest collapse into one
+#: ``{count, sum_s}``-only summary entry, flagged ``hists_saturated``
+BEAT_MAX_HISTS = 64
+#: ... and at most this many piggybacked profiler stacks, each folded
+#: string truncated (utils/profiler.py already bounds depth; this bound
+#: holds even against a misconfigured profiler)
+BEAT_MAX_PROF = 8
+BEAT_MAX_STACK_CHARS = 1024
+
+
+def _counter_deltas(
+    cur: dict[str, int], prev: dict[str, int]
+) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for k, v in cur.items():
+        if k.endswith("_peak"):
+            # windowed gauge (rolled upstream per snapshot): the entry
+            # value IS the window's peak, not a cumulative difference
+            if v:
+                out[k] = v
+            continue
+        d = v - prev.get(k, 0)
+        if d < 0:
+            d = v  # process restart reset the counter: re-baseline
+        if d:
+            out[k] = d
+    return out
+
+
+def _hist_delta(
+    cur: dict[str, Any], prev: dict[str, Any] | None
+) -> dict[str, Any] | None:
+    if prev is None or cur.get("count", 0) < prev.get("count", 0):
+        # first sight, or the count went BACKWARDS (restart — or a
+        # series that fell out of a saturated beat payload and came
+        # back): baseline only, book NO delta. Booking the cumulative
+        # snapshot here would re-count the series' whole history as one
+        # window delta and inflate every rate/percentile the SLO engine
+        # reads; losing one interval is the safe failure mode.
+        return None
+    c = cur.get("count", 0) - prev.get("count", 0)
+    if c <= 0:
+        return None
+    pb = prev.get("buckets", {})
+    buckets = {}
+    for k, v in cur.get("buckets", {}).items():
+        d = v - pb.get(k, 0)
+        if d > 0:
+            buckets[k] = d
+    return {
+        "count": c,
+        "sum_s": max(cur.get("sum_s", 0.0) - prev.get("sum_s", 0.0), 0.0),
+        "buckets": buckets,
+    }
+
+
+def series_scale(name: str) -> float:
+    """Display scale for a histogram series' percentile: latency series
+    read in milliseconds; ``.n``-suffixed count-valued series
+    (``observe_scalar``'s as-if-microseconds encoding) read back as raw
+    values (``hist_percentile * 1e6``)."""
+    return 1e6 if name.endswith(".n") else 1e3
+
+
+class TimeSeriesRing:
+    """Bounded ring of timestamped telemetry deltas (thread-safe).
+
+    ``observe(cumulative_snapshot, ts)`` appends the delta vs the
+    previous observation; windowed reads (``window``/``rate``/
+    ``percentile``/``summary``) merge the entries younger than
+    ``window_s``. The same class serves both feeds: a node observing its
+    own rolls and the coordinator observing each node's beat stream."""
+
+    def __init__(self, capacity: int = 360):
+        self.capacity = max(int(capacity), 2)
+        self._buf: deque[dict[str, Any]] = deque(maxlen=self.capacity)
+        self._prev: dict[str, Any] | None = None
+        self._prev_ts: float | None = None
+        self._lock = threading.Lock()
+
+    def observe(
+        self, snap: dict[str, Any], ts: float | None = None
+    ) -> dict[str, Any] | None:
+        """Record the delta between ``snap`` (a cumulative telemetry
+        snapshot) and the previous observation. The first observation
+        only baselines (returns None) — a delta needs two points."""
+        if ts is None:
+            ts = time.time()
+        with self._lock:
+            prev, prev_ts = self._prev, self._prev_ts
+            if prev_ts is not None and ts <= prev_ts:
+                # out-of-order feeder race: discard WITHOUT touching the
+                # baseline — regressing _prev to the older snapshot
+                # would make the next delta double-count this interval
+                return None
+            self._prev, self._prev_ts = snap, ts
+            if prev is None or prev_ts is None:
+                return None
+            hists: dict[str, Any] = {}
+            for name, cur in (snap.get("hists") or {}).items():
+                d = _hist_delta(cur, (prev.get("hists") or {}).get(name))
+                if d is not None:
+                    hists[name] = d
+            entry = {
+                "ts": ts,
+                "dt_s": ts - prev_ts,
+                "counters": _counter_deltas(
+                    snap.get("counters") or {}, prev.get("counters") or {}
+                ),
+                "hists": hists,
+            }
+            self._buf.append(entry)
+            return entry
+
+    def entries(
+        self, window_s: float | None = None, now: float | None = None
+    ) -> list[dict[str, Any]]:
+        with self._lock:
+            out = list(self._buf)
+        if window_s is None:
+            return out
+        if now is None:
+            now = time.time()
+        lo = now - window_s
+        # strict cut: an entry stamped exactly at the window edge covers
+        # the second BEFORE the window, so it stays out — a "4 s window"
+        # then merges exactly 4 s of delta coverage, not 5
+        return [e for e in out if e["ts"] > lo]
+
+    def window(
+        self, window_s: float, now: float | None = None
+    ) -> dict[str, Any]:
+        """One merged delta over the window: summed counters (peaks as
+        max), bucket-wise merged histogram deltas, total covered dt."""
+        counters: dict[str, int] = {}
+        hists: dict[str, list[dict]] = {}
+        dt = 0.0
+        n = 0
+        for e in self.entries(window_s, now):
+            dt += e["dt_s"]
+            n += 1
+            for k, v in e["counters"].items():
+                if k.endswith("_peak"):
+                    counters[k] = max(counters.get(k, 0), v)
+                else:
+                    counters[k] = counters.get(k, 0) + v
+            for k, v in e["hists"].items():
+                hists.setdefault(k, []).append(v)
+        return {
+            "dt_s": dt,
+            "samples": n,
+            "counters": counters,
+            "hists": {k: merge_hist_snapshots(v) for k, v in hists.items()},
+        }
+
+    def rate(
+        self, counter: str, window_s: float, now: float | None = None
+    ) -> float:
+        w = self.window(window_s, now)
+        return w["counters"].get(counter, 0) / w["dt_s"] if w["dt_s"] else 0.0
+
+    def percentile(
+        self, hist: str, p: float, window_s: float,
+        now: float | None = None,
+    ) -> float:
+        """Windowed percentile in SECONDS (callers scale for display —
+        see ``series_scale``); 0.0 when the window has no observations."""
+        w = self.window(window_s, now)
+        snap = w["hists"].get(hist)
+        return hist_percentile(snap, p) if snap else 0.0
+
+    def summary(
+        self, window_s: float, now: float | None = None
+    ) -> dict[str, Any]:
+        """The wire/dashboard form: windowed counter rates (per second)
+        and per-series p50/p99 in display units (ms for latency series,
+        raw values for ``.n`` count series)."""
+        w = self.window(window_s, now)
+        dt = w["dt_s"]
+        rates = {
+            k: round(v / dt, 3)
+            for k, v in sorted(w["counters"].items())
+            if not k.endswith("_peak")
+        } if dt else {}
+        p50: dict[str, float] = {}
+        p99: dict[str, float] = {}
+        hist_rates: dict[str, float] = {}
+        for name, snap in sorted(w["hists"].items()):
+            if snap.get("buckets"):
+                # bucketless deltas (the beat guard's "_saturated"
+                # count/sum summary) have no percentile — emitting one
+                # would report the top bucket edge (~6 days) as a p99
+                sc = series_scale(name)
+                p50[name] = round(hist_percentile(snap, 0.5) * sc, 3)
+                p99[name] = round(hist_percentile(snap, 0.99) * sc, 3)
+            if dt:
+                # observations per second: command histograms double as
+                # the dashboard's push/s / pull/s throughput columns
+                hist_rates[name] = round(snap.get("count", 0) / dt, 3)
+        return {
+            "window_s": window_s,
+            "dt_s": round(dt, 3),
+            "samples": w["samples"],
+            "rates": rates,
+            "hist_rates": hist_rates,
+            "peaks": {
+                k: v for k, v in sorted(w["counters"].items())
+                if k.endswith("_peak")
+            },
+            "p50": p50,
+            "p99": p99,
+        }
+
+
+# -- the node-local ring + roll ---------------------------------------------
+
+_local = TimeSeriesRing()
+
+
+def local_ring() -> TimeSeriesRing:
+    """This process's own ring (fed by ``local_roll``; served windowed
+    by the metrics endpoint and piggybacked summaries)."""
+    return _local
+
+
+def reset_local_ring(capacity: int = 360) -> TimeSeriesRing:
+    """Swap in a fresh ring (process start / tests)."""
+    global _local
+    _local = TimeSeriesRing(capacity)
+    return _local
+
+
+def local_roll(snap: dict[str, Any] | None = None) -> dict[str, Any]:
+    """Observe one cumulative snapshot into the local ring (the
+    heartbeat path passes the snapshot it is about to piggyback so one
+    beat costs one snapshot). Returns the snapshot."""
+    if snap is None:
+        snap = telemetry_snapshot()
+    _local.observe(snap)
+    wire_counters.inc("ts_rolls")
+    flightrec.record("ts.roll", n=len(snap.get("counters") or {}))
+    return snap
+
+
+class Roller:
+    """Background roll cadence for processes with no heartbeat (the
+    train path, benches): one daemon thread calling ``local_roll`` every
+    ``interval_s``."""
+
+    def __init__(self, interval_s: float = 5.0):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ps-ts-roller"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            local_roll()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+# -- heartbeat payload guard ------------------------------------------------
+
+
+def beat_telemetry(snap: dict[str, Any] | None = None) -> dict[str, Any]:
+    """The bounded beat payload: the cumulative snapshot with its
+    histogram and profiler blocks saturated to summaries past the
+    per-beat budget. Also rolls the local ring (one snapshot serves the
+    beat, the ring and the guard)."""
+    snap = local_roll(snap)
+    out = dict(snap)
+    hists = snap.get("hists") or {}
+    if len(hists) > BEAT_MAX_HISTS:
+        # keep the heaviest series whole; the tail collapses into ONE
+        # count/sum-only summary so the beat can never grow unboundedly
+        # with series cardinality (the KeyHeatSketch saturation move)
+        ranked = sorted(
+            hists.items(), key=lambda kv: -kv[1].get("count", 0)
+        )
+        kept = dict(ranked[:BEAT_MAX_HISTS])
+        dropped = ranked[BEAT_MAX_HISTS:]
+        kept["_saturated"] = {
+            "count": sum(s.get("count", 0) for _, s in dropped),
+            "sum_s": sum(s.get("sum_s", 0.0) for _, s in dropped),
+            "buckets": {},
+        }
+        out["hists"] = kept
+        out["hists_saturated"] = len(dropped)
+    prof = snap.get("prof")
+    if prof:
+        out["prof"] = [
+            {
+                "s": str(p.get("s", ""))[:BEAT_MAX_STACK_CHARS],
+                "n": int(p.get("n", 0)),
+            }
+            for p in prof[:BEAT_MAX_PROF]
+        ]
+    return out
+
+
+# -- OpenMetrics endpoint ---------------------------------------------------
+
+_NAME_OK = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:"
+
+
+def _metric_name(raw: str) -> str:
+    cleaned = "".join(c if c in _NAME_OK else "_" for c in raw)
+    if not cleaned or cleaned[0].isdigit():
+        cleaned = "_" + cleaned
+    return "ps_" + cleaned
+
+
+def _fmt(v: float) -> str:
+    return repr(round(float(v), 9)) if isinstance(v, float) else str(v)
+
+
+def render_openmetrics(
+    snap: dict[str, Any], proc: str = ""
+) -> str:
+    """Strict OpenMetrics text exposition of one cumulative telemetry
+    snapshot: counters (``_total``), ``*_peak`` gauges, histograms with
+    cumulative ``le`` buckets at the log2 microsecond edges (exposed in
+    seconds; ``.n`` count series in raw values), timers as two counters,
+    ``# EOF`` terminator."""
+    label = f'{{proc="{proc}"}}' if proc else ""
+    lines: list[str] = []
+    for name in sorted(snap.get("counters") or {}):
+        v = snap["counters"][name]
+        m = _metric_name(name)
+        if name.endswith("_peak"):
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m}{label} {_fmt(v)}")
+        else:
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m}_total{label} {_fmt(v)}")
+    for name in sorted(snap.get("hists") or {}):
+        s = snap["hists"][name]
+        count_valued = name.endswith(".n")
+        m = _metric_name(name if count_valued else name + "_seconds")
+        lines.append(f"# TYPE {m} histogram")
+        buckets = {int(k): int(v) for k, v in s.get("buckets", {}).items()}
+        cum = 0
+        for i in sorted(buckets):
+            cum += buckets[i]
+            edge = float(1 << i) if count_valued else (1 << i) / 1e6
+            le = f'le="{_fmt(edge)}"'
+            lab = f'{{proc="{proc}",{le}}}' if proc else f"{{{le}}}"
+            lines.append(f"{m}_bucket{lab} {cum}")
+        inf_lab = (
+            f'{{proc="{proc}",le="+Inf"}}' if proc else '{le="+Inf"}'
+        )
+        lines.append(f"{m}_bucket{inf_lab} {s.get('count', 0)}")
+        total = s.get("sum_s", 0.0)
+        if count_valued:
+            total *= 1e6  # decode the as-if-microseconds value encoding
+        lines.append(f"{m}_sum{label} {_fmt(float(total))}")
+        lines.append(f"{m}_count{label} {s.get('count', 0)}")
+    for name in sorted(snap.get("timers") or {}):
+        t = snap["timers"][name]
+        m = _metric_name("timer_" + name)
+        lines.append(f"# TYPE {m}_seconds counter")
+        lines.append(
+            f"{m}_seconds_total{label} {_fmt(float(t.get('total_s', 0.0)))}"
+        )
+        lines.append(f"# TYPE {m}_calls counter")
+        lines.append(f"{m}_calls_total{label} {int(t.get('count', 0))}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Stdlib HTTP scrape endpoint: ``/metrics`` (OpenMetrics text of
+    this process's cumulative telemetry — Prometheus derives its own
+    rates) + ``/healthz`` (JSON liveness INCLUDING this node's own
+    windowed view: the local ring's rates/p99 summary, so a human or a
+    load balancer can read "how is this node doing right now" without
+    the coordinator). ``port=0`` binds an ephemeral port (tests);
+    ``.port`` is the bound port either way."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        process_name: str = "",
+        snapshot_fn: Callable[[], dict[str, Any]] | None = None,
+        health_fn: Callable[[], dict[str, Any]] | None = None,
+        window_s: float = 60.0,
+    ):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.process_name = process_name
+        # observe-only snapshots: a scrape must never consume the
+        # heartbeat plane's rolled peak windows
+        snap_fn = snapshot_fn or (
+            lambda: telemetry_snapshot(roll_peaks=False)
+        )
+        # default health: liveness + the node's own windowed summary
+        # over the configured [timeseries] window (the local ring is
+        # fed by beat_telemetry / a Roller; _local resolves at call
+        # time so a later reset_local_ring is picked up)
+        hf = health_fn or (
+            lambda: {"ok": True, "window": _local.summary(window_s)}
+        )
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — stdlib handler API
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        wire_counters.inc("ts_scrapes")
+                        body = render_openmetrics(
+                            snap_fn(), outer.process_name
+                        ).encode()
+                        ctype = (
+                            "application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8"
+                        )
+                    elif self.path.split("?")[0] == "/healthz":
+                        doc = {
+                            "proc": outer.process_name,
+                            "time": time.time(),
+                            **hf(),
+                        }
+                        body = (json.dumps(doc) + "\n").encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except BrokenPipeError:  # scraper hung up mid-reply
+                    pass
+
+            def log_message(self, *a: Any) -> None:  # stay silent
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self.address = f"{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.2},
+            daemon=True,
+            name="ps-metrics",
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def start_metrics_server(
+    port: int,
+    process_name: str = "",
+    snapshot_fn: Callable[[], dict[str, Any]] | None = None,
+    health_fn: Callable[[], dict[str, Any]] | None = None,
+    window_s: float = 60.0,
+    host: str = "127.0.0.1",
+) -> MetricsServer:
+    """Bind and serve the OpenMetrics endpoint (see MetricsServer).
+    The loopback default serves same-host scrapers; pass
+    ``[timeseries] metrics_host = "0.0.0.0"`` for an off-host
+    Prometheus."""
+    return MetricsServer(
+        port=port, host=host, process_name=process_name,
+        snapshot_fn=snapshot_fn, health_fn=health_fn, window_s=window_s,
+    )

@@ -1,0 +1,41 @@
+"""Child process for tests/test_liveops.py: one "worker" node that
+registers with the parent's coordinator and heartbeats REAL telemetry —
+per-beat latency observations + counter bumps piggybacked through the
+bounded ``beat_telemetry()`` payload — so the parent can assert that
+``cli top --once`` renders live rates/p99/health from an actual
+2-process cluster, not from hand-fed snapshots.
+
+Usage: python _liveops_child_node.py <coordinator host:port>
+"""
+
+from __future__ import annotations
+
+
+def main() -> None:
+    import sys
+    import time
+
+    from parameter_server_tpu.parallel.control import ControlClient
+    from parameter_server_tpu.utils.heartbeat import host_stats
+    from parameter_server_tpu.utils.metrics import (
+        latency_histograms,
+        wire_counters,
+    )
+    from parameter_server_tpu.utils.timeseries import beat_telemetry
+
+    ctl = ControlClient(sys.argv[1], reconnect_timeout_s=5.0)
+    nid = ctl.register("worker", rank=0)
+    print("READY", nid, flush=True)
+    # beat fast (the parent's window math needs >= 2 deltas quickly) with
+    # a steady synthetic load so windowed rates/p99 are nonzero
+    while True:
+        for _ in range(5):
+            latency_histograms.observe("client.push", 0.004)
+            latency_histograms.observe("client.pull", 0.002)
+        wire_counters.inc("wire_bytes_out", 1000)
+        ctl.beat(nid, {**host_stats(), "telemetry": beat_telemetry()})
+        time.sleep(0.1)
+
+
+if __name__ == "__main__":
+    main()

@@ -237,3 +237,31 @@ class TestBenchCompactServeCell:
         c = bench._compact_contract(full, "f.json")
         assert "error" in c["sub"]["serve"]
         assert len(json.dumps(c)) < 1500
+
+
+class TestBenchCompactObservabilityCell:
+    def test_observability_ratio_rides_the_compact_line(self):
+        """ISSUE 13 acceptance plumbing: the wire_rpc cell's full-
+        observability overhead ratio (flightrec + timeseries + profiler
+        armed vs all off) reaches the driver-recorded compact line."""
+        import json
+
+        full = {
+            "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+            "platform": "cpu", "raw": {}, "suite_wall_s": 1.0,
+            "sub": {
+                "wire_rpc": {
+                    "roundtrips_per_sec": 900.0,
+                    "pull_p50_ms": 1.0,
+                    "push_p99_ms": 4.1,
+                    "pipelined_speedup_w8": 3.4,
+                    "mb_s_1mib_pipelined": 700.0,
+                    "flightrec_ratio": 0.99,
+                    "observability_ratio": 0.97,
+                },
+            },
+        }
+        line = json.dumps(bench._compact_contract(full, "f.json"))
+        assert len(line) < 1500
+        c = json.loads(line)
+        assert c["sub"]["rpc"]["observability_ratio"] == 0.97

@@ -1,0 +1,665 @@
+"""ISSUE 13 — live operations plane: cluster time series, OpenMetrics
+endpoint, continuous profiler, SLO burn-rate alerts, `cli top`.
+
+Covers the tentpole's three layers plus the satellites: delta-ring math
+(rates + exact bucket-wise histogram deltas -> windowed percentiles),
+OpenMetrics format validation against a real scrape, the sampling
+profiler's identity-pinned-disarmed discipline and exports, multi-window
+burn-rate gating with once-per-episode alert hysteresis, the heartbeat
+payload guard, and the acceptance drills: `cli top --once` rendering a
+live 2-process cluster and an induced shed storm whose SLO alert lands
+in `cli top`, the flight recorder and `cli postmortem`.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from parameter_server_tpu.utils import profiler, slo, timeseries
+from parameter_server_tpu.utils.metrics import (
+    latency_histograms,
+    telemetry_snapshot,
+    wire_counters,
+)
+from parameter_server_tpu.utils.timeseries import TimeSeriesRing
+
+HERE = Path(__file__).resolve().parent
+
+
+def _snap(counters=None, hists=None, **extra):
+    return {
+        "counters": dict(counters or {}),
+        "hists": dict(hists or {}),
+        "timers": {},
+        **extra,
+    }
+
+
+def _hist(count, bucket, sum_s=None):
+    return {
+        "count": count,
+        "sum_s": sum_s if sum_s is not None else count * 1e-3,
+        "buckets": {str(bucket): count},
+    }
+
+
+class TestTimeSeriesRing:
+    def test_counter_deltas_become_windowed_rates(self):
+        r = TimeSeriesRing(16)
+        assert r.observe(_snap({"a": 0}), ts=100.0) is None  # baseline
+        r.observe(_snap({"a": 10}), ts=101.0)
+        r.observe(_snap({"a": 30}), ts=102.0)
+        assert r.rate("a", window_s=10, now=102.0) == pytest.approx(15.0)
+        # window filtering: a 1 s window holds only the last delta
+        assert r.rate("a", window_s=1.0, now=102.0) == pytest.approx(20.0)
+        # a counter absent from the window rates as 0
+        assert r.rate("zzz", window_s=10, now=102.0) == 0.0
+
+    def test_restart_rebaselines_instead_of_negative_rate(self):
+        r = TimeSeriesRing()
+        r.observe(_snap({"a": 1000}), ts=1.0)
+        r.observe(_snap({"a": 5}), ts=2.0)  # process restarted: 5 < 1000
+        assert r.rate("a", 10, now=2.0) == pytest.approx(5.0)
+
+    def test_peak_gauges_ride_entries_and_merge_as_max(self):
+        r = TimeSeriesRing()
+        r.observe(_snap({"x_peak": 9}), ts=1.0)
+        r.observe(_snap({"x_peak": 7}), ts=2.0)
+        r.observe(_snap({"x_peak": 3}), ts=3.0)
+        w = r.window(10, now=3.0)
+        assert w["counters"]["x_peak"] == 7  # max over the window deltas
+        assert "x_peak" not in r.summary(10, now=3.0)["rates"]
+
+    def test_exact_bucketwise_histogram_deltas_and_percentiles(self):
+        r = TimeSeriesRing()
+        r.observe(_snap(hists={"server.push": _hist(4, 10)}), ts=1.0)
+        # 4 more observations land in bucket 14 (~16 ms): the delta is
+        # EXACTLY those 4, so the windowed p50 moves while the
+        # cumulative histogram's p50 would still straddle both buckets
+        cum = {
+            "count": 8, "sum_s": 0.2,
+            "buckets": {"10": 4, "14": 4},
+        }
+        r.observe(_snap(hists={"server.push": cum}), ts=2.0)
+        p99 = r.percentile("server.push", 0.99, window_s=1.5, now=2.0)
+        p50 = r.percentile("server.push", 0.5, window_s=1.5, now=2.0)
+        assert p50 == p99 == (1 << 14) / 1e6  # only the delta's bucket
+        s = r.summary(1.5, now=2.0)
+        assert s["p99"]["server.push"] == pytest.approx((1 << 14) / 1e3)
+        assert s["hist_rates"]["server.push"] == pytest.approx(4.0)
+
+    def test_capacity_bounds_the_ring(self):
+        r = TimeSeriesRing(4)
+        for i in range(20):
+            r.observe(_snap({"a": i}), ts=float(i))
+        assert len(r.entries()) == 4
+
+    def test_summary_scales_count_valued_series_raw(self):
+        r = TimeSeriesRing()
+        # observe_scalar encoding: value v recorded as v microseconds
+        r.observe(_snap(hists={"server.apply_queue.n": _hist(1, 0)}), ts=1.0)
+        r.observe(
+            _snap(hists={"server.apply_queue.n": {
+                "count": 3, "sum_s": 96e-6, "buckets": {"0": 1, "6": 2},
+            }}),
+            ts=2.0,
+        )
+        s = r.summary(1.5, now=2.0)
+        # delta buckets: {6: 2} -> p99 = 2^6 = 64 queue entries, raw units
+        assert s["p99"]["server.apply_queue.n"] == pytest.approx(64.0)
+
+
+def validate_openmetrics(text: str) -> dict[str, str]:
+    """Minimal OpenMetrics validator: returns {family: type}. Asserts
+    the EOF terminator, name grammar, counter ``_total`` suffixes and
+    histogram bucket coherence (cumulative, +Inf == count)."""
+    lines = text.splitlines()
+    assert lines, "empty exposition"
+    assert lines[-1] == "# EOF", "must end with the EOF terminator"
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    sample_re = re.compile(
+        r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(?P<labels>\{[^{}]*\})? (?P<value>[^ ]+)$"
+    )
+    types: dict[str, str] = {}
+    samples: list[tuple[str, str, float]] = []
+    for ln in lines[:-1]:
+        assert ln == ln.strip(), f"stray whitespace: {ln!r}"
+        if ln.startswith("# TYPE "):
+            _, _, fam, typ = ln.split(" ")
+            assert name_re.match(fam), fam
+            assert typ in ("counter", "gauge", "histogram", "summary"), typ
+            assert fam not in types, f"duplicate TYPE for {fam}"
+            types[fam] = typ
+        elif ln.startswith("#"):
+            continue
+        else:
+            m = sample_re.match(ln)
+            assert m, f"malformed sample line: {ln!r}"
+            samples.append(
+                (m["name"], m["labels"] or "", float(m["value"]))
+            )
+    fam_of: dict[str, str] = {}
+    for name, labels, value in samples:
+        fam = name
+        for suffix in ("_total", "_bucket", "_sum", "_count"):
+            if fam.endswith(suffix) and fam[: -len(suffix)] in types:
+                fam = fam[: -len(suffix)]
+                break
+        assert fam in types, f"sample {name} has no TYPE metadata"
+        fam_of[name] = fam
+        if types[fam] == "counter":
+            assert name == fam + "_total", (
+                f"counter sample must use _total: {name}"
+            )
+            assert value >= 0
+    for fam, typ in types.items():
+        if typ != "histogram":
+            continue
+        buckets = [
+            (labels, v) for n, labels, v in samples if n == fam + "_bucket"
+        ]
+        assert buckets, f"histogram {fam} has no buckets"
+        les = []
+        for labels, v in buckets:
+            m = re.search(r'le="([^"]+)"', labels)
+            assert m, f"bucket without le: {fam} {labels}"
+            les.append((
+                float("inf") if m[1] == "+Inf" else float(m[1]), v,
+            ))
+        les.sort(key=lambda x: x[0])
+        assert les[-1][0] == float("inf"), f"{fam} missing +Inf bucket"
+        counts = [v for _, v in les]
+        assert counts == sorted(counts), f"{fam} buckets not cumulative"
+        total = next(v for n, _, v in samples if n == fam + "_count")
+        assert les[-1][1] == total, f"{fam} +Inf bucket != count"
+    return types
+
+
+class TestOpenMetrics:
+    def test_render_passes_format_validation(self):
+        latency_histograms.observe("client.push", 0.004)
+        latency_histograms.observe("client.push", 0.0001)
+        from parameter_server_tpu.utils.metrics import observe_scalar
+
+        observe_scalar("server.apply_batch.n", 7)
+        wire_counters.inc("wire_bytes_out", 123)
+        wire_counters.observe_max("rpc_inflight_peak", 5)
+        text = timeseries.render_openmetrics(
+            telemetry_snapshot(roll_peaks=False), proc="worker-0"
+        )
+        types = validate_openmetrics(text)
+        assert types.get("ps_wire_bytes_out") == "counter"
+        assert types.get("ps_rpc_inflight_peak") == "gauge"
+        assert types.get("ps_client_push_seconds") == "histogram"
+        # count-valued series expose raw-valued buckets, no _seconds
+        assert types.get("ps_server_apply_batch_n") == "histogram"
+        assert 'proc="worker-0"' in text
+
+    def test_live_scrape_and_healthz(self):
+        srv = timeseries.start_metrics_server(0, process_name="scrape-0")
+        try:
+            scrapes0 = wire_counters.get("ts_scrapes")
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                assert "openmetrics-text" in resp.headers["Content-Type"]
+                validate_openmetrics(resp.read().decode())
+            assert wire_counters.get("ts_scrapes") == scrapes0 + 1
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=10
+            ) as resp:
+                doc = json.loads(resp.read().decode())
+            assert doc["ok"] is True and doc["proc"] == "scrape-0"
+        finally:
+            srv.close()
+
+
+class TestBeatPayloadGuard:
+    def test_beat_payload_stays_bounded_under_long_runs(self):
+        """A long run accumulating hundreds of histogram series and deep
+        profiler stacks must still produce a bounded beat payload: the
+        tail saturates to one count/sum summary (the KeyHeatSketch
+        discipline), stacks truncate."""
+        hists = {
+            f"server.cmd{i:04d}": _hist(i + 1, 12) for i in range(400)
+        }
+        snap = _snap({"wire_bytes_out": 1}, hists)
+        snap["prof"] = [
+            {"s": "frame;" * 2000, "n": 5} for _ in range(50)
+        ]
+        ring0 = timeseries.reset_local_ring()
+        try:
+            out = timeseries.beat_telemetry(snap)
+        finally:
+            assert timeseries.local_ring() is ring0
+        assert len(out["hists"]) == timeseries.BEAT_MAX_HISTS + 1
+        assert out["hists_saturated"] == 400 - timeseries.BEAT_MAX_HISTS
+        # the saturated summary preserves the dropped series' mass
+        kept = sum(
+            s["count"] for k, s in out["hists"].items() if k != "_saturated"
+        )
+        assert kept + out["hists"]["_saturated"]["count"] == sum(
+            i + 1 for i in range(400)
+        )
+        assert len(out["prof"]) == timeseries.BEAT_MAX_PROF
+        assert all(
+            len(p["s"]) <= timeseries.BEAT_MAX_STACK_CHARS
+            for p in out["prof"]
+        )
+        assert len(json.dumps(out)) < 64_000  # the per-beat byte budget
+
+    def test_beat_rolls_the_local_ring_and_counts(self):
+        timeseries.reset_local_ring()
+        rolls0 = wire_counters.get("ts_rolls")
+        timeseries.beat_telemetry(_snap({"a": 1}))
+        timeseries.beat_telemetry(_snap({"a": 3}))
+        assert wire_counters.get("ts_rolls") == rolls0 + 2
+        assert timeseries.local_ring().rate("a", 60) > 0
+
+
+class TestProfiler:
+    def test_disarmed_is_identity_pinned_noop(self):
+        assert profiler.top_stacks is profiler._noop_top_stacks
+        assert not profiler.enabled()
+        assert "prof" not in telemetry_snapshot(roll_peaks=False)
+
+    def test_sampling_finds_the_busy_frame_and_rides_telemetry(self):
+        def _liveops_busy_loop(stop):
+            while not stop.is_set():
+                sum(i * i for i in range(200))
+
+        stop = threading.Event()
+        t = threading.Thread(
+            target=_liveops_busy_loop, args=(stop,), name="busy"
+        )
+        t.start()
+        p = profiler.configure(500, top_n=10, process_name="prof-test")
+        try:
+            deadline = time.monotonic() + 5.0
+            while p.samples < 30 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert p.samples >= 30
+            tops = profiler.top_stacks()
+            assert tops and any(
+                "_liveops_busy_loop" in s["s"] for s in tops
+            )
+            snap = telemetry_snapshot(roll_peaks=False)
+            assert snap["prof"] == tops or snap["prof"]  # bounded block
+            assert wire_counters.get("prof_samples") > 0
+        finally:
+            stop.set()
+            t.join()
+            profiler.configure(0)
+        assert profiler.top_stacks is profiler._noop_top_stacks
+
+    def test_dump_writes_collapsed_and_perfetto_exports(self, tmp_path):
+        p = profiler.configure(0)  # make sure we start clean
+        p = profiler.SamplingProfiler(hz=100, process_name="dump-test")
+        for _ in range(20):
+            p.sample_once()
+        dumps0 = wire_counters.get("prof_dumps")
+        out = p.dump(str(tmp_path))
+        assert out is not None
+        collapsed = Path(out["collapsed"]).read_text().splitlines()
+        assert collapsed and all(
+            re.match(r"^.+ \d+$", ln) for ln in collapsed
+        )
+        doc = json.loads(Path(out["trace"]).read_text())
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert evs and all(
+            e["dur"] >= 1.0 and "ts" in e and "tid" in e for e in evs
+        )
+        assert wire_counters.get("prof_dumps") == dumps0 + 1
+
+    def test_env_hz_grammar(self):
+        assert profiler.env_hz("") == 0.0
+        assert profiler.env_hz("off") == 0.0
+        assert profiler.env_hz("0") == 0.0
+        assert profiler.env_hz("1") == profiler.DEFAULT_HZ
+        assert profiler.env_hz("true") == profiler.DEFAULT_HZ
+        assert profiler.env_hz("97") == 97.0
+        assert profiler.env_hz("not-a-rate") == profiler.DEFAULT_HZ
+
+
+class TestSloEngine:
+    def _storm_ring(self, t0=1000.0, n=12, shed_per_s=100):
+        ring = TimeSeriesRing()
+        ring.observe(_snap({"serve_shed": 0}), ts=t0)
+        for i in range(1, n + 1):
+            ring.observe(
+                _snap({"serve_shed": i * shed_per_s}), ts=t0 + i
+            )
+        return ring
+
+    def test_rule_grammar(self):
+        r = slo.parse_rule(
+            "shed rate:serve_shed <= 2 target 0.9 burn 3"
+        )
+        assert (r.name, r.kind, r.series) == ("shed", "rate", "serve_shed")
+        assert r.threshold == 2 and r.target == 0.9 and r.burn == 3
+        for bad in (
+            "noop",  # too short
+            "x rate:serve_shed >= 2",  # only <= is the grammar
+            "x blah:serve_shed <= 2",  # unknown kind
+            "x rate:serve_shed <= 2 target",  # dangling option
+            "x rate:serve_shed <= 2 frobnicate 2",  # unknown option
+        ):
+            with pytest.raises(ValueError):
+                slo.parse_rule(bad)
+        # the shipped defaults must parse (config <-> engine contract)
+        from parameter_server_tpu.utils.config import SloConfig
+
+        rules = slo.parse_rules(SloConfig().rules)
+        assert {r.name for r in rules} >= {
+            "push_p99_ms", "shed_rate", "stall_count", "ssp_blocked_ms",
+            "apply_queue_depth", "replication_lag_s",
+        }
+
+    def test_burn_is_dt_weighted_bad_fraction_over_budget(self):
+        rule = slo.parse_rule(
+            "shed rate:serve_shed <= 10 target 0.9 burn 2"
+        )
+        eng = slo.SloEngine([rule], short_window_s=4, long_window_s=8)
+        ring = TimeSeriesRing()
+        # 8 seconds of history: 2 bad (100/s), 6 good (0/s)
+        ring.observe(_snap({"serve_shed": 0}), ts=0.0)
+        for i in range(1, 7):
+            ring.observe(_snap({"serve_shed": 0}), ts=float(i))
+        ring.observe(_snap({"serve_shed": 100}), ts=7.0)
+        ring.observe(_snap({"serve_shed": 200}), ts=8.0)
+        fl = eng._bad_fraction(ring, rule, 8.0, now=8.0)
+        assert fl == pytest.approx(2 / 8)
+        fs = eng._bad_fraction(ring, rule, 4.0, now=8.0)
+        assert fs == pytest.approx(2 / 4)
+        # budget = 1 - 0.9: burn multiples are fraction / 0.1
+        rep = eng.evaluate({0: ring}, now=8.0)
+        a = rep["alerts"][0]
+        assert a["burn_short"] == pytest.approx(5.0)
+        assert a["burn_long"] == pytest.approx(2.5)
+
+    def test_short_window_blip_alone_does_not_fire(self):
+        """Multi-window gating: a blip that burns the short window but
+        not the long one is not sustained — no alert."""
+        rule = slo.parse_rule(
+            "shed rate:serve_shed <= 2 target 0.9 burn 5"
+        )
+        eng = slo.SloEngine([rule], short_window_s=2, long_window_s=20)
+        ring = TimeSeriesRing()
+        ring.observe(_snap({"serve_shed": 0}), ts=0.0)
+        for i in range(1, 19):
+            ring.observe(_snap({"serve_shed": 0}), ts=float(i))
+        ring.observe(_snap({"serve_shed": 100}), ts=19.0)  # 1 bad second
+        rep = eng.evaluate({0: ring}, now=19.0)
+        assert rep["alerts"] == []
+        assert rep["health"]["0"]["score"] == 100
+
+    def test_alert_fires_once_per_episode_and_rearms(self):
+        rule = slo.parse_rule("shed rate:serve_shed <= 2 target 0.9 burn 2")
+        eng = slo.SloEngine([rule], short_window_s=3, long_window_s=6)
+        ring = self._storm_ring(t0=1000.0, n=8)
+        ctr0 = wire_counters.get("slo_alerts")
+        # repeated evaluation during ONE sustained storm: one episode
+        for _ in range(5):
+            rep = eng.evaluate({7: ring}, now=1008.0)
+            assert len(rep["alerts"]) == 1
+        assert eng.episodes == 1
+        assert wire_counters.get("slo_alerts") == ctr0 + 1
+        # recovery: shed stops; both windows age out -> cleared
+        for i in range(1, 9):
+            ring.observe(_snap({"serve_shed": 800}), ts=1008.0 + i)
+        rep = eng.evaluate({7: ring}, now=1016.0)
+        assert rep["alerts"] == []
+        assert rep["health"]["7"]["score"] == 100
+        # a SECOND storm is a new episode
+        for i in range(1, 9):
+            ring.observe(
+                _snap({"serve_shed": 800 + i * 100}), ts=1016.0 + i
+            )
+        rep = eng.evaluate({7: ring}, now=1024.0)
+        assert len(rep["alerts"]) == 1
+        assert eng.episodes == 2
+        assert wire_counters.get("slo_alerts") == ctr0 + 2
+
+    def test_data_gap_during_active_episode_does_not_refire(self):
+        """A beat pause mid-incident must not end the episode: when
+        data resumes still burning, that is the SAME episode, not a
+        second rising edge."""
+        rule = slo.parse_rule("q p99:server.push <= 1 target 0.9 burn 2")
+        eng = slo.SloEngine([rule], short_window_s=3, long_window_s=6)
+        ring = TimeSeriesRing()
+        bad = lambda i: _snap(hists={"server.push": {
+            "count": 4 * i, "sum_s": 0.2 * i, "buckets": {"14": 4 * i},
+        }})
+        ring.observe(bad(1), ts=100.0)
+        for i in range(2, 9):
+            ring.observe(bad(i), ts=100.0 + i)
+        rep = eng.evaluate({0: ring}, now=108.0)
+        assert len(rep["alerts"]) == 1 and eng.episodes == 1
+        # data gap: both windows age out entirely — episode survives,
+        # alert stays active and is marked stale
+        rep = eng.evaluate({0: ring}, now=130.0)
+        assert len(rep["alerts"]) == 1 and rep["alerts"][0]["stale"]
+        assert rep["health"]["0"]["burning"] == ["q"]
+        # beats resume, still burning: same episode, no second edge
+        for i in range(9, 17):
+            ring.observe(bad(i), ts=122.0 + i)
+        rep = eng.evaluate({0: ring}, now=138.0)
+        assert len(rep["alerts"]) == 1 and "stale" not in rep["alerts"][0]
+        assert eng.episodes == 1
+
+    def test_bucketless_saturation_summary_has_no_percentile(self):
+        """The beat guard's '_saturated' count/sum entry has no buckets
+        — it must neither report a (top-bucket-edge) percentile in
+        summaries nor trip a p99 SLO rule."""
+        ring = TimeSeriesRing()
+        sat = lambda n: _snap(hists={"_saturated": {
+            "count": n, "sum_s": 0.1 * n, "buckets": {},
+        }})
+        ring.observe(sat(10), ts=1.0)
+        ring.observe(sat(30), ts=2.0)
+        s = ring.summary(10, now=2.0)
+        assert "_saturated" not in s["p99"]
+        assert s["hist_rates"]["_saturated"] == pytest.approx(20.0)
+        rule = slo.parse_rule("x p99:_saturated <= 1 burn 1")
+        eng = slo.SloEngine([rule], short_window_s=5, long_window_s=10)
+        rep = eng.evaluate({0: ring}, now=2.0)
+        assert rep["alerts"] == []
+        assert rep["health"]["0"]["rules_evaluated"] == 0  # no verdict
+
+    def test_dormant_series_neither_burns_nor_counts(self):
+        """replication_lag_s is declared (reserved for direction #1) but
+        nothing emits it: no data, no burn, not in the evaluable set."""
+        rules = slo.parse_rules([
+            "shed rate:serve_shed <= 2",
+            "replication_lag_s p99:replication_lag_s <= 1",
+        ])
+        eng = slo.SloEngine(rules, short_window_s=3, long_window_s=6)
+        ring = TimeSeriesRing()
+        ring.observe(_snap({"serve_shed": 0}), ts=0.0)
+        ring.observe(_snap({"serve_shed": 0}), ts=1.0)
+        rep = eng.evaluate({0: ring}, now=1.0)
+        assert rep["alerts"] == []
+        assert rep["health"]["0"]["rules_evaluated"] == 1  # shed only
+
+
+class TestHeartbeatSeries:
+    def test_monitor_retains_history_instead_of_overwriting(self):
+        from parameter_server_tpu.utils.heartbeat import HeartbeatMonitor
+
+        mon = HeartbeatMonitor(timeout_s=30.0, series_capacity=8)
+        for i in range(5):
+            mon.beat(3, {"telemetry": _snap({"pushes": i * 10})})
+        rings = mon.node_series()
+        assert list(rings) == [3]
+        assert len(rings[3].entries()) == 4  # 5 beats -> 4 deltas
+        assert rings[3].rate("pushes", window_s=3600) > 0
+        # latest_stats keeps the point-sample contract
+        assert mon.latest_stats()[3]["telemetry"]["counters"]["pushes"] == 40
+        mon.forget(3)
+        assert mon.node_series() == {}
+
+    def test_config_sections_load(self, tmp_path):
+        from parameter_server_tpu.utils.config import load_config
+
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps({
+            "timeseries": {"capacity": 99, "metrics_port": 9100},
+            "profile": {"hz": 29.0, "top_n": 3},
+            "slo": {
+                "rules": ["shed rate:serve_shed <= 1"],
+                "short_window_s": 5.0,
+            },
+        }))
+        cfg = load_config(p)
+        assert cfg.timeseries.capacity == 99
+        assert cfg.timeseries.metrics_port == 9100
+        assert cfg.profile.hz == 29.0 and cfg.profile.top_n == 3
+        assert cfg.slo.rules == ["shed rate:serve_shed <= 1"]
+        assert cfg.slo.short_window_s == 5.0 and cfg.slo.long_window_s == 300.0
+
+
+class TestLiveCluster:
+    def test_cli_top_once_renders_a_live_two_process_cluster(self, capsys):
+        """Acceptance: `cli top --once` renders rates/p99/health from a
+        real coordinator fed by a real heartbeating child process."""
+        from parameter_server_tpu.cli import main as cli_main
+        from parameter_server_tpu.parallel.control import Coordinator
+
+        import os
+
+        coord = Coordinator()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (
+            str(HERE.parent) + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        child = subprocess.Popen(
+            [
+                sys.executable, str(HERE / "_liveops_child_node.py"),
+                coord.address,
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        try:
+            line = child.stdout.readline()
+            assert line.startswith("READY"), (
+                line,
+                (child.stderr.read() or "")[-800:]
+                if child.poll() is not None else "",
+            )
+            # the coordinator needs >= 2 retained beats for a delta
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                rings = coord._monitor.node_series()
+                if rings and len(next(iter(rings.values())).entries()) >= 3:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("child beats never reached the coordinator")
+            rc = cli_main([
+                "top", "--scheduler", coord.address, "--once",
+                "--window", "30",
+            ])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "ps top" in out and "worker" in out
+            assert "no active SLO alerts" in out
+            # the worker row renders a nonzero push rate, p99 and health
+            row = next(
+                ln for ln in out.splitlines() if " worker " in ln
+            )
+            cols = row.split()
+            push_rate, p99_push = float(cols[3]), float(cols[6])
+            assert push_rate > 0 and p99_push > 0
+            assert cols[8] == "100"  # healthy node scores 100
+        finally:
+            child.kill()
+            child.wait(timeout=10)
+            child.stdout.close()
+            child.stderr.close()
+            coord.stop()
+
+
+class TestShedStormDrill:
+    def test_storm_alert_lands_in_top_flightrec_and_postmortem(
+        self, tmp_path, capsys
+    ):
+        """Acceptance: an induced shed storm fires the SLO alert ONCE
+        per episode and the alert is visible in `cli top --once`, the
+        flight recorder and the postmortem report."""
+        from parameter_server_tpu.cli import main as cli_main
+        from parameter_server_tpu.parallel.control import (
+            ControlClient,
+            Coordinator,
+        )
+        from parameter_server_tpu.utils import flightrec
+        from parameter_server_tpu.utils.config import SloConfig
+        from parameter_server_tpu.utils.postmortem import postmortem
+
+        box = tmp_path / "box"
+        flightrec.configure(
+            str(box), process_name="scheduler-0",
+            flush_interval_s=0, watchdog_interval_s=3600,
+        )
+        coord = Coordinator(
+            slo_cfg=SloConfig(
+                rules=["shed_rate rate:serve_shed <= 2 target 0.9 burn 2"],
+                short_window_s=0.8,
+                long_window_s=1.6,
+            ),
+        )
+        ctl = ControlClient(coord.address)
+        try:
+            nid = ctl.register("server", rank=0)
+            ctr0 = wire_counters.get("slo_alerts")
+            # the storm: ~2 s of beats showing serve_shed climbing fast
+            shed = 0
+            for _ in range(20):
+                shed += 50
+                ctl.beat(nid, {"telemetry": _snap({"serve_shed": shed})})
+                time.sleep(0.1)
+            # repeated telemetry queries during ONE sustained storm must
+            # fire exactly one episode
+            for _ in range(3):
+                rep = ctl.telemetry(window_s=5.0)
+            alerts = rep["slo"]["alerts"]
+            assert len(alerts) == 1 and alerts[0]["rule"] == "shed_rate"
+            assert rep["slo"]["health"][str(nid)]["burning"] == ["shed_rate"]
+            assert wire_counters.get("slo_alerts") == ctr0 + 1
+            # visible in cli top --once
+            rc = cli_main([
+                "top", "--scheduler", coord.address, "--once",
+            ])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "ACTIVE SLO ALERTS (1):" in out
+            assert "[shed_rate]" in out
+            # ... in the flight recorder ...
+            assert any(
+                e[2] == "slo.alert" for e in flightrec.events()
+            )
+            assert flightrec.dump("drill-complete") is not None
+        finally:
+            ctl.close()
+            coord.stop()
+            flightrec.configure(None)
+        # ... and in the postmortem report
+        pm = postmortem(str(box))
+        slo_anoms = [
+            a for a in pm["anomalies"] if a["kind"] == "slo-alert"
+        ]
+        assert len(slo_anoms) == 1
+        assert slo_anoms[0]["rule"] == "shed_rate"
+        assert "slo-alert" in pm["report"]
+        assert pm["unknown_events"] == {}
