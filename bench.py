@@ -1332,22 +1332,27 @@ def child_wire_rpc() -> dict:
 
         # FULL observability overhead guard (ISSUE 13 acceptance: push
         # throughput with flightrec + time-series rolling + the sampling
-        # profiler ALL armed within 5% of all-off). The roller runs far
-        # above its production cadence (0.1 s vs one roll per heartbeat)
-        # and the profiler at its default Hz, so this is a conservative
-        # ceiling on what a fully-instrumented node pays.
+        # profiler ALL armed within 5% of all-off; ISSUE 14 extends the
+        # armed side with the audit event spool — every push's
+        # issue/reply now also passes the spool's admission filter, the
+        # exact cost a live-audited production node pays). The roller
+        # runs far above its production cadence (0.1 s vs one roll per
+        # heartbeat) and the profiler at its default Hz, so this is a
+        # conservative ceiling on what a fully-instrumented node pays.
         from parameter_server_tpu.utils import profiler as prof_mod
         from parameter_server_tpu.utils import timeseries as ts_mod
 
         obs_rounds = []
         for _ in range(5):
             flightrec.configure(None)
+            flightrec.configure_spool(None)
             prof_mod.configure(0)
             off = _rps_pipelined(400)
             flightrec.configure(
                 bb_dir, process_name="bench-wire_rpc",
                 flush_interval_s=0, watchdog_interval_s=60,
             )
+            flightrec.configure_spool(4096)
             prof_mod.configure(prof_mod.DEFAULT_HZ)
             roller = ts_mod.Roller(0.1)
             try:
@@ -1356,6 +1361,7 @@ def child_wire_rpc() -> dict:
                 roller.close()
                 prof_mod.configure(0)
                 flightrec.configure(None)
+                flightrec.configure_spool(None)
             obs_rounds.append((off, on))
         out["push_rps_observability_off"] = round(
             stats.median(r[0] for r in obs_rounds), 1

@@ -20,7 +20,11 @@ Derived inventories, diffed both ways:
   record``) can evaluate to — IfExp/BoolOp branches included;
 - **known**: every etype literal ``utils/postmortem.py`` compares or
   membership-tests against an ``[\"etype\"]`` subscript, plus the
-  ``_CONTEXT_EVENTS`` pass-through inventory.
+  ``_CONTEXT_EVENTS`` pass-through inventory, plus (ISSUE 14) every
+  ``EVENTS`` frozenset a streaming monitor declares in
+  ``analysis/monitors.py`` — the postmortem's protocol detectors ARE
+  those monitors now, so the registry's consumed-event sets are the
+  detector tables.
 
 An emitted event the postmortem doesn't know is a finding at the
 ``record`` call site (add it to a detector or to ``_CONTEXT_EVENTS`` —
@@ -125,12 +129,43 @@ def _is_etype_expr(expr: ast.AST) -> bool:
     )
 
 
-def known_events(index: PackageIndex) -> dict[str, list[tuple[str, int]]]:
-    """etype -> [(relpath, line)] the postmortem plane handles: every
-    literal compared/membership-tested against an etype subscript plus
-    the ``_CONTEXT_EVENTS`` inventory."""
-    pm = index.get(_POSTMORTEM_REL)
+_MONITORS_REL = "analysis/monitors.py"
+
+
+def _monitor_declared_events(
+    index: PackageIndex,
+) -> dict[str, list[tuple[str, int]]]:
+    """etype -> sites for every ``EVENTS = frozenset({...})`` a
+    streaming monitor declares (ISSUE 14): the monitors are the
+    postmortem's detectors, so their consumed sets count as known."""
+    mf = index.get(_MONITORS_REL)
     out: dict[str, list[tuple[str, int]]] = {}
+    if mf is None:
+        return out
+    for node in ast.walk(mf.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        if node.value is None:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "EVENTS":
+                for name in _str_consts(node.value):
+                    out.setdefault(name, []).append(
+                        (_MONITORS_REL, node.lineno)
+                    )
+    return out
+
+
+def known_events(index: PackageIndex) -> dict[str, list[tuple[str, int]]]:
+    """etype -> [(relpath, line)] the diagnostic plane handles: every
+    literal compared/membership-tested against an etype subscript in
+    the postmortem, the ``_CONTEXT_EVENTS`` inventory, and the
+    streaming monitors' declared ``EVENTS`` sets."""
+    pm = index.get(_POSTMORTEM_REL)
+    out: dict[str, list[tuple[str, int]]] = _monitor_declared_events(index)
     if pm is None:
         return out
     for node in ast.walk(pm.tree):

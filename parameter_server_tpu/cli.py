@@ -176,6 +176,39 @@ def _build_parser() -> argparse.ArgumentParser:
         "--once", action="store_true",
         help="print one frame and exit (scripts / tests)",
     )
+    tp.add_argument(
+        "--json", action="store_true",
+        help="one-shot machine-readable output (implies --once): the "
+        "same blocks the dashboard renders — nodes, windowed series, "
+        "health, active alerts, audit — as one JSON document for CI "
+        "and scripts",
+    )
+
+    au = sub.add_parser(
+        "audit",
+        help="the live audit plane (streaming protocol sentinel): "
+        "violations of the invariants psmc proves offline — "
+        "acked-but-unapplied pushes, double applies, RCU version "
+        "regressions, SSP staleness overruns, reconnects without "
+        "heals, shed storms — detected by the coordinator's streaming "
+        "monitors over the heartbeat event bus; one-shot summary or "
+        "live follow",
+    )
+    au.add_argument("--scheduler", required=True, help="coordinator host:port")
+    au.add_argument(
+        "--interval", type=float, default=2.0,
+        help="follow-mode poll cadence in seconds",
+    )
+    au.add_argument(
+        "--once", action="store_true",
+        help="print one summary and exit (nonzero when violations "
+        "exist — CI drills gate on it)",
+    )
+    au.add_argument("--json", action="store_true")
+    au.add_argument(
+        "--recent", type=int, default=20,
+        help="recent violations to include in the panel",
+    )
 
     pm = sub.add_parser(
         "postmortem",
@@ -796,6 +829,19 @@ def run_top(args: argparse.Namespace) -> int:
                     "window_s", 0.0
                 )
             )
+            if getattr(args, "json", False):
+                # one-shot machine-readable frame: the same blocks the
+                # dashboard renders, schema contract-tested in tier-1
+                slo_rep = rep.get("slo") or {}
+                print(json.dumps({
+                    "window_s": float(shown_window or 0.0),
+                    "nodes": rep.get("nodes") or {},
+                    "series": rep.get("series") or {},
+                    "health": slo_rep.get("health") or {},
+                    "alerts": slo_rep.get("alerts") or [],
+                    "audit": rep.get("audit") or {},
+                }, default=float))
+                return 0
             frame = format_top(rep, float(shown_window or 0.0))
             if args.once:
                 print(frame)
@@ -803,6 +849,43 @@ def run_top(args: argparse.Namespace) -> int:
             # ANSI home+clear: the `top` idiom — repaint in place
             print("\x1b[2J\x1b[H" + frame, flush=True)
             time_mod.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        ctl.close()
+
+
+def run_audit(args: argparse.Namespace) -> int:
+    """The live audit plane's viewer (``cli audit``): one-shot summary
+    (exit 1 when violations exist, so drills and CI gate on it) or a
+    follow loop printing each NEW violation as the coordinator's
+    streaming monitors raise it."""
+    import time as time_mod
+
+    from parameter_server_tpu.parallel.control import ControlClient
+    from parameter_server_tpu.utils.slo import format_audit, format_violation
+
+    ctl = ControlClient(args.scheduler, retries=5, reconnect_timeout_s=5.0)
+    try:
+        rep = ctl.audit(recent=args.recent)
+        if args.json:
+            print(json.dumps(rep, default=float))
+            return 1 if rep.get("total") else 0
+        if args.once:
+            print(format_audit(rep))
+            return 1 if rep.get("total") else 0
+        # follow mode: poll, print only what is new since the last frame
+        print(format_audit(rep))
+        seen = int(rep.get("total") or 0)
+        while True:
+            time_mod.sleep(args.interval)
+            rep = ctl.audit(recent=args.recent)
+            total = int(rep.get("total") or 0)
+            if total > seen:
+                fresh = (rep.get("recent") or [])[-(total - seen):]
+                for v in fresh:
+                    print(format_violation(v).strip(), flush=True)
+                seen = total
     except KeyboardInterrupt:
         return 0
     finally:
@@ -910,6 +993,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "top":
         # no config file: the dashboard reads the live coordinator
         return run_top(args)
+    if args.cmd == "audit":
+        # no config file: the sentinel reads the live coordinator
+        return run_audit(args)
     if args.cmd == "postmortem":
         # no config file: a postmortem works from the dumps alone
         from parameter_server_tpu.utils.postmortem import postmortem

@@ -263,7 +263,7 @@ _CMD_IDS: dict[str, int] = {
         "workload_finish", "workload_stats", "workload_reassign", "progress",
         "progress_merged", "beat", "telemetry", "dead", "recovered",
         "ssp_init", "ssp_wait", "ssp_finish", "ssp_retire", "ssp_progress",
-        "echo",
+        "echo", "audit",
     ))
 }
 _CMD_NAMES = {i: c for c, i in _CMD_IDS.items()}
@@ -537,7 +537,7 @@ _PRIO_CMDS = frozenset({
     "beat", "barrier", "register", "nodes", "dead", "recovered", "stats",
     "ssp_init", "ssp_wait", "ssp_finish", "ssp_retire",
     "ssp_progress", "workload_fetch", "workload_finish", "workload_stats",
-    "workload_reassign",
+    "workload_reassign", "audit",
 })
 
 
@@ -1867,7 +1867,9 @@ class Coordinator:
         slo_cfg: "SloConfig | None" = None,
         series_capacity: int = 360,
         series_window_s: float = 60.0,
+        audit_cfg: "AuditConfig | None" = None,
     ):
+        from parameter_server_tpu.utils.auditor import Auditor
         from parameter_server_tpu.utils.config import SloConfig
         from parameter_server_tpu.utils.slo import SloEngine, parse_rules
 
@@ -1901,6 +1903,12 @@ class Coordinator:
 
         self._self_ring = TimeSeriesRing(series_capacity)
         self._self_last = 0.0
+        # the live audit plane (ISSUE 14): heartbeat-piggybacked event
+        # batches from every node stream through the shared protocol
+        # monitors here; the coordinator's OWN spooled events (SSP clock
+        # movements, its rpc traffic) are drained inline each sweep as
+        # the "coord" stream, the way _self_ring covers its telemetry
+        self._auditor = Auditor(audit_cfg)
         self._clock: SSPClock | None = None
         self._cv = threading.Condition()
         # batched beat/progress ingestion (ROADMAP carry-over): these
@@ -1929,7 +1937,7 @@ class Coordinator:
             idempotent_cmds=frozenset({
                 "kv_get", "kv_set", "nodes", "beat", "progress",
                 "progress_merged", "workload_stats", "ssp_progress",
-                "telemetry",
+                "telemetry", "audit",
             }),
             blocking_cmds=frozenset({"barrier", "ssp_wait", "kv_get"}),
         )
@@ -1966,10 +1974,29 @@ class Coordinator:
     def _slo_rings(self) -> dict[Any, Any]:
         return {**self._monitor.node_series(), "coord": self._self_ring}
 
+    def _audit_pass(self) -> None:
+        """One audit-plane pass: drain this process's own event spool
+        (when armed) into the auditor as the "coord" stream, then run
+        the watermark flush so unpaired facts past their window become
+        violations. Rides the sweep AND every audit/telemetry query —
+        violations must fire with no viewer attached."""
+        from parameter_server_tpu.utils import flightrec
+
+        sp = flightrec.audit_spool()
+        if sp is not None:
+            batches = sp.drain(max_batches=16)
+            if batches:
+                self._auditor.ingest("coord", batches, role="coordinator")
+                sp.ack()  # no wire between drain and ingest: always lands
+        self._auditor.flush()
+
     def _sweep_once(self) -> None:
         self._drain_ingest(wait=True)  # a queued beat must not read dead
         # SLO pass rides the sweep cadence: alerts must fire (and land in
-        # the flight recorder) even when nobody is watching `cli top`
+        # the flight recorder) even when nobody is watching `cli top`.
+        # Audit first: a violation bumped now is in the snapshot the
+        # self-ring roll below hands the burn-rate engine.
+        self._audit_pass()
         self._observe_self()
         self._slo.evaluate(self._slo_rings())
         for nid in self._monitor.dead():
@@ -2157,8 +2184,30 @@ class Coordinator:
                         for worker, record in prog:
                             self._progress[worker] = record
                         self._cv.notify_all()
+                # audit plane: peel each beat's piggybacked event batches
+                # BEFORE the monitor retains the stats (latest_stats is a
+                # telemetry view, not an event bus), then feed them after
+                # the monitor lock is released — the auditor locks itself
+                audit_feed: list[tuple[int, list]] = []
+                for node_id, stats in beats:
+                    if isinstance(stats, dict):
+                        batches = stats.pop("audit", None)
+                        if batches:
+                            audit_feed.append((node_id, batches))
                 if beats:
                     self._monitor.beat_many(beats)
+                if audit_feed:
+                    # role hints tighten hole-suppression targeting (a
+                    # holed WORKER stream cannot hide a missing commit)
+                    with self._cv:
+                        roles = {
+                            nid: self._nodes.get(nid, {}).get("role")
+                            for nid, _ in audit_feed
+                        }
+                    for node_id, batches in audit_feed:
+                        self._auditor.ingest(
+                            node_id, batches, role=roles.get(node_id)
+                        )
                 if len(batch) > 1:
                     wire_counters.inc("coord_ingest_coalesced", len(batch) - 1)
                 # loop: frames appended while we applied are ours too —
@@ -2200,6 +2249,7 @@ class Coordinator:
             str(nid): ring.summary(window_s)
             for nid, ring in rings.items()
         }
+        self._audit_pass()
         return {
             "ok": True,
             "nodes": per_node,
@@ -2207,6 +2257,20 @@ class Coordinator:
             "merged": merge_telemetry(node_snaps + [local]),
             "series": series,
             "slo": self._slo.evaluate(rings),
+            "audit": self._auditor.summary(),
+        }, {}
+
+    def _cmd_audit(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
+        """The audit plane's read endpoint (``cli audit``): violation
+        totals/panel + per-node stream accounting, after draining any
+        queued beats (an acked batch is visible) and a watermark pass."""
+        self._drain_ingest(wait=True)
+        self._audit_pass()
+        return {
+            "ok": True,
+            "audit": self._auditor.summary(
+                recent=int(h.get("recent") or 20)
+            ),
         }, {}
 
     def _cmd_dead(self, h: dict, _: Arrays) -> tuple[dict, Arrays]:
@@ -2232,6 +2296,11 @@ class Coordinator:
                 watchdog.register(
                     f"ssp-clock:{id(self._clock)}",
                     self._clock.stall_probe,
+                )
+                # the audit plane's SSP monitor checks granted gate
+                # passes against exactly this bound (dormant until told)
+                self._auditor.set_ssp(
+                    int(h["num_workers"]), int(h["max_delay"])
                 )
         return {"ok": True}, {}
 
@@ -2347,9 +2416,17 @@ class ControlClient(RpcClient):
         rep, _ = self.call("telemetry", window_s=window_s)
         return {
             k: rep[k]
-            for k in ("nodes", "coordinator", "merged", "series", "slo")
+            for k in (
+                "nodes", "coordinator", "merged", "series", "slo", "audit",
+            )
             if k in rep
         }
+
+    def audit(self, recent: int = 20) -> dict[str, Any]:
+        """The audit plane's summary: violation totals, recent panel,
+        per-node stream accounting (``cli audit``'s feed)."""
+        rep, _ = self.call("audit", recent=recent)
+        return rep["audit"]
 
     def ssp_init(self, num_workers: int, max_delay: int) -> None:
         self.call("ssp_init", num_workers=num_workers, max_delay=max_delay)

@@ -702,13 +702,17 @@ class ShardServer:
                     self.state = new_state
                     commit_ver = self.version
         if todo:
-            # the postmortem's acked-vs-applied ledger: every (cid, seq)
-            # this commit made durable, against the version it produced
-            # (pairs capped — a 64-push batch still fits one ring slot)
+            # the postmortem's AND the live auditor's acked-vs-applied
+            # ledger: every (cid, seq) this commit made durable, against
+            # the version it produced. The full batch, never a slice —
+            # a truncated ledger makes the streaming ack⇒applied monitor
+            # read the tail pushes as acked-but-unapplied on a healthy
+            # cluster whenever [server] max_batch exceeds the cap (the
+            # event stays bounded by max_batch, an operator knob)
             flightrec.record(
                 "apply.commit", ver=commit_ver, pushes=len(todo),
                 pairs=[
-                    [p.cid, p.seq] for p in todo[:64] if p.cid is not None
+                    [p.cid, p.seq] for p in todo if p.cid is not None
                 ],
             )
         with self._ctr_lock:
@@ -2007,10 +2011,12 @@ class _RemoteBeatSink:
             scheduler, reconnect_timeout_s=1.0
         )
 
-    def beat(self, node_id: int, stats: dict | None = None) -> None:
+    def beat(self, node_id: int, stats: dict | None = None) -> bool:
         # a single transient socket failure must not silence beats forever
         # (a healthy node would read as dead): drop the connection and
-        # rebuild it on the next beat
+        # rebuild it on the next beat. Returns delivery success so the
+        # reporter knows whether to ack the audit-spool batches the beat
+        # carried (False = they stay in flight for the next beat).
         try:
             if self._ctl is None:
                 self._ctl = ControlClient(
@@ -2018,10 +2024,12 @@ class _RemoteBeatSink:
                     reconnect_timeout_s=1.0,
                 )
             self._ctl.beat(node_id, stats)
+            return True
         except Exception:
             if self._ctl is not None:
                 self._ctl.close()
             self._ctl = None
+            return False
 
     def close(self) -> None:
         if self._ctl is not None:
@@ -2036,8 +2044,24 @@ class _Beats:
     named timers), which is what the coordinator's ``telemetry`` command
     merges into the cluster view — no second collection path."""
 
-    def __init__(self, scheduler: str, node_id: int, interval_s: float):
+    def __init__(
+        self,
+        scheduler: str,
+        node_id: int,
+        interval_s: float,
+        audit_cfg: "AuditConfig | None" = None,
+    ):
         self._sink = _RemoteBeatSink(scheduler)
+        # audit plane (ISSUE 14): heartbeating nodes arm the flightrec
+        # event spool so their protocol-invariant events (push acks,
+        # apply commits, RCU publishes, heals, sheds) ride every beat to
+        # the coordinator's streaming auditor; the reporter drains/acks
+        self._armed_spool = False
+        if audit_cfg is not None and audit_cfg.enabled:
+            flightrec.configure_spool(
+                audit_cfg.spool_capacity, audit_cfg.batch_events
+            )
+            self._armed_spool = True
 
         def beat_stats() -> dict:
             # ONE snapshot serves three planes (ISSUE 13): the beat
@@ -2064,6 +2088,8 @@ class _Beats:
         watchdog.unregister(self._wd_name)
         self._rep.stop()
         self._sink.close()
+        if self._armed_spool:
+            flightrec.configure_spool(None)
 
 
 def run_server(
@@ -2108,7 +2134,10 @@ def run_server(
     # set AFTER any resume: workers re-resolving this key must never beat
     # the state load and pull pre-resume zeros
     ctl.kv_set(f"server_addr/{rank}", addr=srv.address)
-    beats = _Beats(scheduler, node_id, cfg.fault.heartbeat_interval_s)
+    beats = _Beats(
+        scheduler, node_id, cfg.fault.heartbeat_interval_s,
+        audit_cfg=cfg.audit,
+    )
     srv.serve_forever()  # until the scheduler's shutdown
     if ckpt_dir:
         srv.stop_checkpointing()  # no periodic writer behind the final dump
@@ -2165,7 +2194,10 @@ def run_worker(
         scheduler, reconnect_timeout_s=cfg.fault.reconnect_timeout_s
     )
     node_id = ctl.register("worker", rank=rank)
-    beats = _Beats(scheduler, node_id, cfg.fault.heartbeat_interval_s)
+    beats = _Beats(
+        scheduler, node_id, cfg.fault.heartbeat_interval_s,
+        audit_cfg=cfg.audit,
+    )
     # the scheduler's ssp_init/workload_init must land before our first
     # fetch; registration order doesn't guarantee it, this kv flag does
     ctl.kv_get("scheduler_init_done", block=True, timeout=120)
@@ -2788,6 +2820,16 @@ def run_node(
             # scheduler never beats, so without this its /healthz
             # window would stay empty forever and read as a wedged node
             roller = timeseries.Roller(cfg.fault.heartbeat_interval_s)
+    # audit plane (ISSUE 14): the scheduler has no heartbeat reporter,
+    # so its own spool (SSP clock movements, control rpc.reply acks) is
+    # drained inline by the coordinator's audit pass — arm it here, with
+    # the same role gate the _Beats path applies on servers/workers
+    armed_spool = False
+    if role == "scheduler" and cfg.audit.enabled:
+        flightrec.configure_spool(
+            cfg.audit.spool_capacity, cfg.audit.batch_events
+        )
+        armed_spool = True
     try:
         if role == "scheduler":
             host, port = scheduler.rsplit(":", 1)
@@ -2798,6 +2840,7 @@ def run_node(
                 slo_cfg=cfg.slo,
                 series_capacity=cfg.timeseries.capacity,
                 series_window_s=cfg.timeseries.window_s,
+                audit_cfg=cfg.audit,
             )
             return run_scheduler(cfg, coord, num_servers, num_workers, model_out)
         if role == "server":
@@ -2814,3 +2857,5 @@ def run_node(
             roller.close()
         if msrv is not None:
             msrv.close()
+        if armed_spool:
+            flightrec.configure_spool(None)

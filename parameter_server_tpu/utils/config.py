@@ -456,6 +456,40 @@ class ProfileConfig:
 
 
 @dataclass
+class AuditConfig:
+    """Live audit plane (ISSUE 14): nodes spool audit-relevant
+    flight-recorder events (utils/flightrec.py ``EventSpool``) and ship
+    them as sequence-numbered batches on the heartbeat piggyback; the
+    coordinator streams them through the shared protocol monitors
+    (analysis/monitors.py via utils/auditor.py) — the LIVE incarnation
+    of the invariants psmc proves offline (exactly-once pushes, RCU
+    version monotonicity, SSP staleness, heal convergence, shed storms).
+    Violations fire ``audit.violation`` flight-recorder events, bump
+    ``audit_violations`` (the dormant-until-violated ``[slo]`` hook),
+    and surface in ``cli top`` and ``cli audit``."""
+
+    enabled: bool = True
+    # node-side event spool bound; a full spool drops NEW events and
+    # counts them (``audit_spool_dropped``) — the auditor reads the
+    # drop watermark and suppresses verdicts over holed windows
+    spool_capacity: int = 4096
+    # events per drained batch (a beat carries up to 4 batches)
+    batch_events: int = 512
+    # pairing window: an acked push whose apply.commit has not been
+    # seen this many seconds after the ack arrived is a violation
+    # (must comfortably exceed the heartbeat interval — the commit
+    # rides the SERVER's next beat)
+    watermark_s: float = 15.0
+    # a heal.begin with no rpc.healed after this long is a violation
+    heal_timeout_s: float = 30.0
+    # shed-storm detector: >= n sheds within window_s
+    shed_storm_n: int = 10
+    shed_storm_window_s: float = 1.0
+    # recent violations retained for cli audit / cli top panels
+    recent: int = 256
+
+
+@dataclass
 class SloConfig:
     """Declarative SLO rules (utils/slo.py), evaluated as multi-window
     burn rates over each node's time-series ring at the coordinator.
@@ -481,6 +515,11 @@ class SloConfig:
         "ssp_blocked_ms rate:ssp_blocked_ms <= 500",
         "apply_queue_depth p99:server.apply_queue.n <= 192",
         "replication_lag_s p99:replication_lag_s <= 1",
+        # the audit plane's alert hook (ISSUE 14): the coordinator bumps
+        # audit_violations in its own ring, so a sustained violation
+        # stream pages through the same burn-rate machinery; a clean
+        # cluster's rate is exactly 0 and the rule never burns
+        "audit_violations rate:audit_violations <= 0 target 0.9 burn 1",
     ])
     short_window_s: float = 60.0
     long_window_s: float = 300.0
@@ -512,6 +551,7 @@ class PSConfig:
     timeseries: TimeseriesConfig = field(default_factory=TimeseriesConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     slo: SloConfig = field(default_factory=SloConfig)
+    audit: AuditConfig = field(default_factory=AuditConfig)
     model_output: str = ""
     report_interval: int = 1  # progress print cadence, in reports (ref gflag)
     seed: int = 0
@@ -562,6 +602,7 @@ _NESTED = {
     "timeseries": TimeseriesConfig,
     "profile": ProfileConfig,
     "slo": SloConfig,
+    "audit": AuditConfig,
 }
 
 

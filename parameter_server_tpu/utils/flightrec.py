@@ -54,6 +54,19 @@ per armed process samples them: a source that stays busy without its
 progress counter advancing for ``stall_timeout_s`` fires exactly once
 per stall episode, recording the event, bumping ``watchdog_stalls`` and
 dumping the box with the stalled source + thread named.
+
+The **audit event spool** (ISSUE 14) is the live half of the same
+stream: while armed (``configure_spool``), every recorded event whose
+etype is audit-relevant (:data:`AUDIT_EVENTS` — the protocol-invariant
+carriers: push acks, apply commits/replays, RCU publishes, SSP clock
+movements, heal transitions, sheds) ALSO lands in a bounded
+:class:`EventSpool`. The heartbeat reporter drains the spool into
+sequence-numbered batches piggybacked on each beat and acks them after
+a successful send, so the coordinator's streaming auditor
+(utils/auditor.py) sees an at-least-once, seq-deduplicated event stream
+with explicit saturation accounting (a full spool drops NEW events and
+counts them — the auditor reads the ``dropped`` watermark and knows the
+stream has holes instead of trusting a silently truncated one).
 """
 
 from __future__ import annotations
@@ -93,21 +106,34 @@ def _noop_record(etype: str, **fields: Any) -> None:
 
 
 def _live_record(etype: str, **fields: Any) -> None:
+    # ONE event tuple serves both sinks (ring + audit spool).
+    # get_ident, NOT get_native_id: the ident is a userspace read
+    # (~0.1 us) where the native id is a gettid syscall that costs
+    # ~100x on un-vDSO'd kernels — on a per-frame hot path that
+    # difference IS the recorder's overhead budget. Dumps map ident
+    # -> name/native_id through their thread table.
+    ev = (time.time(), threading.get_ident(), etype, fields)
     buf = _buf
     if buf is not None:
-        # get_ident, NOT get_native_id: the ident is a userspace read
-        # (~0.1 us) where the native id is a gettid syscall that costs
-        # ~100x on un-vDSO'd kernels — on a per-frame hot path that
-        # difference IS the recorder's overhead budget. Dumps map ident
-        # -> name/native_id through their thread table.
-        buf.append((time.time(), threading.get_ident(), etype, fields))
+        buf.append(ev)
+    sp = _spool
+    if sp is not None and etype in AUDIT_EVENTS:
+        sp.offer(etype, ev)
 
 
 #: the module-level recording entry point every instrumented layer calls
-#: (``flightrec.record(...)``): rebound by configure() between the
-#: no-op and the live path, so the disabled cost is one attribute load +
-#: one call that does nothing
+#: (``flightrec.record(...)``): rebound between the no-op and the live
+#: path whenever the ring (configure) or the audit spool
+#: (configure_spool) arms/disarms, so the disabled cost is one attribute
+#: load + one call that does nothing
 record = _noop_record
+
+
+def _rebind_record() -> None:
+    """record is live iff ANY sink (ring, audit spool) is armed; with
+    both off it is the identity-pinned no-op the overhead tests assert."""
+    global record
+    record = _noop_record if (_buf is None and _spool is None) else _live_record
 
 
 def enabled() -> bool:
@@ -122,6 +148,138 @@ def events() -> list[tuple]:
     """Snapshot of the ring (newest last); empty when disarmed."""
     buf = _buf
     return list(buf) if buf is not None else []
+
+
+# -- audit event spool (ISSUE 14) -------------------------------------------
+
+#: the audit-relevant slice of the event stream: exactly the etypes the
+#: streaming monitors (analysis/monitors.py) consume. Everything else
+#: (rpc.in frame noise, trace/step context) stays ring-only — the spool
+#: rides heartbeats and must stay beat-sized.
+AUDIT_EVENTS = frozenset({
+    "rpc.issue", "rpc.reply",           # client push issue/ack (push-only)
+    "apply.commit", "apply.replay",     # server exactly-once ledger proof
+    "rcu.publish",                      # snapshot version stream
+    "ssp.wait", "ssp.finish", "ssp.retire",  # clock movements
+    "rpc.conn_died",                    # heal-chain context
+    "rpc.heal.begin", "rpc.healed", "rpc.heal.failed",
+    "serve.shed",                       # admission-control firings
+})
+
+#: rpc.* issue/ack traffic is per-CALL volume; only push carries the
+#: exactly-once invariant the auditor checks, so pulls/control calls
+#: stay out of the spool entirely (they would saturate it for nothing)
+_AUDIT_RPC_CMDS = frozenset({"push"})
+
+
+class EventSpool:
+    """Bounded spool of audit events, drained as sequence-numbered
+    batches by the heartbeat thread.
+
+    Producers (``record`` on any thread) ``offer`` events lock-free:
+    a deque append is GIL-atomic, and the capacity check is a cheap
+    ``len`` — the bound is therefore soft by at most the number of
+    concurrently appending threads, which is fine for a memory guard.
+    A full spool drops the NEW event and counts it (saturation
+    accounting): the drop watermark rides every batch, so the consumer
+    KNOWS the stream has holes — the difference between "no anomaly"
+    and "no evidence".
+
+    The drain side is single-consumer (the process's heartbeat thread,
+    or the coordinator draining its own spool inline): ``drain()``
+    returns the still-unacked in-flight batches plus newly cut ones,
+    ``ack()`` discards the in-flight set once the carrying beat
+    succeeded. A beat that dies on the wire simply leaves the batches
+    in flight — the next beat re-ships them under the SAME seq numbers
+    and the auditor's per-node seq dedup drops the duplicates."""
+
+    def __init__(self, capacity: int = 4096, batch_events: int = 512):
+        self.capacity = max(int(capacity), 16)
+        self.batch_events = max(int(batch_events), 1)
+        self._buf: deque = deque()
+        self._next_seq = 0
+        self._inflight: list[dict[str, Any]] = []
+        self._lock = threading.Lock()  # drain/ack only — never offer
+
+    def offer(self, etype: str, ev: tuple) -> None:
+        """Hot-path admission (called from ``record``): lock-free."""
+        if etype in ("rpc.issue", "rpc.reply"):
+            if ev[3].get("cmd") not in _AUDIT_RPC_CMDS:
+                return
+        if len(self._buf) >= self.capacity:
+            # saturation: drop NEW (the retained prefix stays causally
+            # contiguous; a drop-oldest spool would silently shear the
+            # pairing windows the monitors reason over)
+            from parameter_server_tpu.utils.metrics import wire_counters
+
+            wire_counters.inc("audit_spool_dropped")
+            return
+        self._buf.append(ev)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    @property
+    def dropped(self) -> int:
+        """Cumulative saturation drops (the batch watermark)."""
+        from parameter_server_tpu.utils.metrics import wire_counters
+
+        return wire_counters.get("audit_spool_dropped")
+
+    def drain(self, max_batches: int = 4) -> list[dict[str, Any]]:
+        """Cut up to ``max_batches`` total batches (unacked in-flight
+        ones first, re-shipped verbatim) for one beat's piggyback."""
+        with self._lock:
+            out = list(self._inflight)
+            dropped = self.dropped
+            while len(out) < max_batches:
+                evs: list[list] = []
+                while len(evs) < self.batch_events:
+                    try:
+                        ev = self._buf.popleft()
+                    except IndexError:
+                        break
+                    evs.append([ev[0], ev[1], ev[2], ev[3]])
+                if not evs:
+                    break
+                batch = {
+                    "seq": self._next_seq,
+                    "events": evs,
+                    # cumulative drop watermark at cut time: the auditor
+                    # diffs consecutive watermarks to find stream holes
+                    "dropped": dropped,
+                }
+                self._next_seq += 1
+                self._inflight.append(batch)
+                out.append(batch)
+            return out
+
+    def ack(self) -> None:
+        """The beat carrying the last ``drain()``'s batches landed."""
+        with self._lock:
+            self._inflight = []
+
+
+_spool: EventSpool | None = None
+
+
+def audit_spool() -> EventSpool | None:
+    """The armed spool (None when the audit plane is off)."""
+    return _spool
+
+
+def configure_spool(
+    capacity: int | None = 4096, batch_events: int = 512
+) -> EventSpool | None:
+    """Arm (capacity > 0) or disarm (``None``/``0``) the audit event
+    spool, rebinding ``record`` so the disarmed-everything path stays
+    the identity-pinned no-op. Re-arming swaps in a fresh spool."""
+    global _spool
+    _spool = (
+        EventSpool(capacity, batch_events) if capacity else None
+    )
+    _rebind_record()
+    return _spool
 
 
 def dump(reason: str, extra: dict[str, Any] | None = None) -> str | None:
@@ -409,7 +567,7 @@ def configure(
     identity-pinned no-op paths. Arming starts the periodic flusher and
     the watchdog thread and installs the crash hooks; re-arming swaps
     the ring (configure at process start, like the tracer)."""
-    global _dir, _buf, _name, _reasons, _stall_log, _flush_stop, record
+    global _dir, _buf, _name, _reasons, _stall_log, _flush_stop
     # stop the previous incarnation's threads first (idempotent)
     if _flush_stop is not None:
         _flush_stop.set()
@@ -418,7 +576,7 @@ def configure(
     if not blackbox_dir:
         _dir = None
         _buf = None
-        record = _noop_record
+        _rebind_record()
         return
     os.makedirs(blackbox_dir, exist_ok=True)
     _dir = blackbox_dir
@@ -426,7 +584,7 @@ def configure(
     _reasons = []
     _stall_log = []
     _buf = deque(maxlen=max(int(capacity), 1))
-    record = _live_record
+    _rebind_record()
     _install_hooks()
     if flush_interval_s > 0:
         _flush_stop = threading.Event()

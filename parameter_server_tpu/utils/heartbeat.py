@@ -178,7 +178,23 @@ class HeartbeatReporter:
         return self
 
     def _beat_once(self) -> None:
-        self.monitor.beat(self.node_id, self._stats_fn())
+        stats = self._stats_fn()
+        # audit plane (ISSUE 14): the beat carries this process's spooled
+        # audit events as seq-numbered batches and acks them only after a
+        # successful send — a beat that dies on the wire leaves them
+        # in-flight and the NEXT beat re-ships the same seqs (the
+        # coordinator's auditor dedups by (node, seq), so at-least-once
+        # delivery here never double-counts there)
+        spool = flightrec.audit_spool()
+        if spool is not None and isinstance(stats, dict):
+            batches = spool.drain()
+            if batches:
+                stats["audit"] = batches
+        # a sink returning False reports delivery failure (the remote
+        # RPC sink); None (the in-process monitor) means delivered
+        ok = self.monitor.beat(self.node_id, stats)
+        if spool is not None and ok is not False:
+            spool.ack()
         self.beats += 1
         flightrec.record("heartbeat.beat", node=self.node_id, n=self.beats)
 

@@ -16,7 +16,16 @@ flusher, the stall watchdog, crash hooks or at exit). Output, via
 - **anomaly flags**: acked-but-unapplied pushes (a client holds an ok
   push reply no surviving server ledgered), RCU version regressions
   within one server life, reconnects that never healed, shed storms,
-  and any watchdog stall dumps (source + thread named);
+  and any watchdog stall dumps (source + thread named). The protocol
+  detectors are not local code: they ARE the shared streaming monitors
+  (analysis/monitors.py) the live audit plane (utils/auditor.py) runs
+  at the coordinator, fed the merged timeline with end-of-stream
+  semantics — one automaton per invariant, so the live and postmortem
+  planes cannot drift (ISSUE 14). What stays postmortem-specific is
+  the EVIDENCE gating: a live stream is complete by construction, a
+  pile of wreckage is not, so acked-but-unapplied verdicts here are
+  additionally gated on a surviving server box that saw the cid within
+  its retained ring window;
 - a **Perfetto-loadable** rendering through the existing trace exporter
   (``trace.write_chrome_trace``): load the merged timeline next to a
   PR-2 trace of the same run;
@@ -57,9 +66,6 @@ _CONTEXT_EVENTS = frozenset({
     "rpc.issue",         # client issue side of the (cid, seq) stitch
     "rpc.out",           # frame left the process
     "signal",            # fatal-signal crash hook fired
-    "ssp.finish",        # SSP clock movement
-    "ssp.retire",        # SSP retirement (dead/reassigned worker)
-    "ssp.wait",          # SSP gate blocked a worker (blocked ms)
     "step.dispatch",     # trainer step anatomy
     "step.retire",
     "thread.exception",  # threading.excepthook crash hook fired
@@ -68,15 +74,21 @@ _CONTEXT_EVENTS = frozenset({
                          # detector's source; the event is context)
 })
 
-#: the detectors'/stitchers' etype literals, repeated as one set so the
-#: RUNTIME unknown-event check below can complement _CONTEXT_EVENTS
-#: (the flightrec-contract checker derives its "known" side from the
-#: actual comparisons in this file, not from this convenience set)
+#: the detectors'/stitchers' etype literals complementing
+#: _CONTEXT_EVENTS for the RUNTIME unknown-event check below. Since
+#: ISSUE 14 the protocol detectors are the shared streaming monitors,
+#: so their consumed-event sets are UNIONED in from the registry —
+#: the ssp.*/heal.*/rcu/apply/reply events moved out of the literal
+#: list the day the monitors took them over (the pslint
+#: ``flightrec-contract`` checker reads the registry's EVENTS sets the
+#: same way, so the derivation and this set stay in lockstep).
+from parameter_server_tpu.analysis.monitors import monitor_events as _mev
+
 _DETECTOR_EVENTS = frozenset({
-    "rpc.in", "rpc.reply", "apply.commit", "apply.replay", "rcu.publish",
-    "rpc.heal.begin", "rpc.healed", "rpc.heal.failed", "serve.shed",
-    "slo.alert",
-})
+    "rpc.in",           # evidence windows (which server boxes saw a cid)
+    "slo.alert",        # ISSUE 13: burn-rate engine firings
+    "audit.violation",  # ISSUE 14: the live auditor's own verdicts
+}) | _mev()
 
 
 def unknown_events(timeline: list[dict[str, Any]]) -> dict[str, int]:
@@ -172,16 +184,6 @@ def stitch_calls(
     return out
 
 
-def _applied_keys(
-    calls: dict[tuple[str, str], list[dict[str, Any]]],
-) -> set[tuple[str, str]]:
-    return {
-        k
-        for k, evs in calls.items()
-        if any(e["etype"] in ("apply.commit", "apply.replay") for e in evs)
-    }
-
-
 def find_anomalies(
     dumps: list[dict[str, Any]],
     timeline: list[dict[str, Any]],
@@ -227,18 +229,41 @@ def find_anomalies(
                 })
 
     calls = stitch_calls(timeline)
-    applied = _applied_keys(calls)
 
-    # acked-but-unapplied pushes: a client-side ok push reply whose
-    # (cid, seq) no server event ever ledgered — only judged when a
-    # server dump that saw THIS cid exists (otherwise the server's box
-    # simply didn't survive, which is absence of evidence), and only for
-    # acks inside that server ring's retained window. The ring is
-    # bounded: a server records more events per push than the client, so
-    # on a long healthy run the oldest client replies outlive their
-    # commits' ring slots — those are evictions, not anomalies. A commit
-    # always precedes the ack it triggers, so an ack at ts >= the
-    # server window start would have its commit retained.
+    # The protocol detectors: the SHARED streaming monitors
+    # (analysis/monitors.py), fed the merged timeline offline — life is
+    # (proc, pid), the watermark clock is event time, and finish()
+    # judges everything still unpaired at end-of-stream. The live
+    # auditor (utils/auditor.py) runs the same automata at the
+    # coordinator, so the two planes flag the same anomaly set from the
+    # same event stream by construction.
+    from parameter_server_tpu.analysis import monitors as monitors_mod
+
+    mons = monitors_mod.make_monitors(
+        shed_storm_n=shed_storm_n, shed_storm_window_s=shed_window_s,
+    )
+    viols: list[dict[str, Any]] = []
+    for ev in timeline:
+        nev = {
+            "ts": ev["ts"], "life": (ev["proc"], ev["pid"]),
+            "etype": ev["etype"], "args": ev["args"], "at": ev["ts"],
+        }
+        for m in mons:
+            if ev["etype"] in m.EVENTS:
+                viols += m.feed(nev)
+    for m in mons:
+        viols += m.finish()
+
+    # Postmortem-specific EVIDENCE gating for acked-but-unapplied: only
+    # judged when a server dump that saw THIS cid exists (otherwise the
+    # server's box simply didn't survive, which is absence of
+    # evidence), and only for acks inside that server ring's retained
+    # window. The ring is bounded: a server records more events per
+    # push than the client, so on a long healthy run the oldest client
+    # replies outlive their commits' ring slots — those are evictions,
+    # not anomalies. A commit always precedes the ack it triggers, so
+    # an ack at ts >= the server window start would have its commit
+    # retained.
     win_start: dict[tuple[str, int], float] = {}
     for ev in timeline:  # ts-sorted: first hit is each box's oldest event
         win_start.setdefault((ev["proc"], ev["pid"]), ev["ts"])
@@ -255,64 +280,32 @@ def find_anomalies(
             w = win_start[(ev["proc"], ev["pid"])]
             for c in cids:
                 server_cid_win[c] = min(server_cid_win.get(c, w), w)
-    for k, evs in sorted(calls.items()):
-        if k in applied or k[0] not in server_cid_win:
-            continue
-        ack_ts = max(
-            (
-                e["ts"]
-                for e in evs
-                if e["etype"] == "rpc.reply"
-                and e["args"].get("cmd") == "push"
-                and e["args"].get("ok", True)
-            ),
-            default=None,
-        )
-        if ack_ts is None or ack_ts < server_cid_win[k[0]]:
-            continue
-        out.append({
-            "kind": "acked-but-unapplied",
-            "cid": k[0], "seq": k[1],
-            "procs": sorted({e["proc"] for e in evs}),
-        })
 
-    # RCU version regressions within one process life (pid): versions
-    # are opaque but monotonic per life — a decrease means a rollback
-    # or a torn publish
-    last_ver: dict[tuple[str, int], int] = {}
-    for ev in timeline:
-        if ev["etype"] != "rcu.publish":
-            continue
-        ver = ev["args"].get("ver")
-        if ver is None:
-            continue
-        pk = (ev["proc"], ev["pid"])
-        prev = last_ver.get(pk)
-        if prev is not None and int(ver) < prev:
+    for v in viols:
+        kind = v["kind"]
+        if kind == "acked-but-unapplied":
+            cid, seq = v["cid"], v["seq"]
+            win = server_cid_win.get(cid)
+            if win is None or v.get("ack_ts", 0.0) < win:
+                continue  # no surviving server evidence: no verdict
             out.append({
-                "kind": "version-regression",
-                "proc": ev["proc"], "pid": ev["pid"],
-                "from": prev, "to": int(ver), "ts": ev["ts"],
+                "kind": kind, "cid": cid, "seq": seq,
+                "procs": sorted(
+                    {e["proc"] for e in calls.get((cid, seq), ())}
+                ),
             })
-        last_ver[pk] = int(ver)
-
-    # reconnects without heals: a process whose heal attempts never
-    # landed — its peer died (or the net partitioned) and stayed gone
-    by_proc: dict[tuple[str, int], dict[str, int]] = {}
-    for ev in timeline:
-        if ev["etype"] in ("rpc.heal.begin", "rpc.healed", "rpc.heal.failed"):
-            c = by_proc.setdefault((ev["proc"], ev["pid"]), {})
-            c[ev["etype"]] = c.get(ev["etype"], 0) + 1
-    for (proc, pid), c in sorted(by_proc.items()):
-        begun = c.get("rpc.heal.begin", 0)
-        healed = c.get("rpc.healed", 0)
-        if begun > healed:
-            out.append({
-                "kind": "reconnect-without-heal",
-                "proc": proc, "pid": pid,
-                "begun": begun, "healed": healed,
-                "failed": c.get("rpc.heal.failed", 0),
-            })
+        elif kind in ("version-regression", "reconnect-without-heal"):
+            proc, pid = v["life"]
+            flat = {
+                k: x for k, x in v.items()
+                if k not in ("life", "monitor")
+            }
+            out.append({**flat, "proc": proc, "pid": pid})
+        else:  # shed-storm, double-applied, ssp-staleness, future kinds
+            flat = {
+                k: x for k, x in v.items() if k not in ("life", "monitor")
+            }
+            out.append(flat)
 
     # SLO alerts (ISSUE 13): the coordinator's burn-rate engine fired —
     # each rising edge is one episode, rendered with its burn multiples
@@ -330,21 +323,22 @@ def find_anomalies(
                 "ts": ev["ts"],
             })
 
-    # shed storms: admission control firing in bursts — readers were
-    # being bounced faster than the engine drained
-    sheds = [e["ts"] for e in timeline if e["etype"] == "serve.shed"]
-    lo = 0
-    for hi in range(len(sheds)):
-        while sheds[hi] - sheds[lo] > shed_window_s:
-            lo += 1
-        if hi - lo + 1 >= shed_storm_n:
+    # audit.violation (ISSUE 14): the LIVE auditor's verdicts land in
+    # the coordinator's black box — a postmortem over a cluster that
+    # ran with the audit plane armed replays what the sentinel saw
+    for ev in timeline:
+        if ev["etype"] == "audit.violation":
+            a = ev["args"]
             out.append({
-                "kind": "shed-storm",
-                "count": hi - lo + 1,
-                "window_s": shed_window_s,
-                "ts": sheds[lo],
+                "kind": "audit-violation",
+                "proc": ev["proc"],
+                "violation": a.get("kind"),
+                "node": a.get("node"),
+                "ts": ev["ts"],
+                **{
+                    k: a[k] for k in ("cid", "seq", "worker") if k in a
+                },
             })
-            break
     return out
 
 

@@ -260,20 +260,35 @@ def _first(d: dict[str, float], *names: str) -> float:
     return 0.0
 
 
+def format_violation(
+    v: dict[str, Any], exclude: tuple[str, ...] = ("kind", "monitor"),
+) -> str:
+    """One audit violation as ``[kind] k=v ...`` — the ONE renderer the
+    `cli audit` panel, its follow loop and `cli top` all share, so a
+    new violation field shows up on every surface at once."""
+    kv = " ".join(
+        f"{k}={v[k]}" for k in sorted(v) if k not in exclude
+    )
+    return f"  [{v.get('kind')}] {kv}"
+
+
 def format_top(rep: dict[str, Any], window_s: float) -> str:
     """Render one dashboard frame from a coordinator ``telemetry`` reply
-    carrying ``series`` (per-node windowed summaries) and ``slo``."""
+    carrying ``series`` (per-node windowed summaries), ``slo`` and the
+    ``audit`` plane's verdict."""
     series: dict[str, Any] = rep.get("series") or {}
     slo: dict[str, Any] = rep.get("slo") or {}
     health: dict[str, Any] = slo.get("health") or {}
     nodes: dict[str, Any] = rep.get("nodes") or {}
+    audit: dict[str, Any] = rep.get("audit") or {}
+    audit_nodes: dict[str, Any] = audit.get("nodes") or {}
     lines = [
         f"ps top — {len(nodes)} node(s), window {window_s:.0f}s, "
         f"{time.strftime('%H:%M:%S')}",
         "",
         f"{'node':>5} {'role':<10} {'rank':>4} {'push/s':>9} "
         f"{'pull/s':>9} {'shed/s':>8} {'p99_push':>9} {'q_p99':>7} "
-        f"{'health':>7}  alerts",
+        f"{'health':>7} {'audit':>6}  alerts",
     ]
     def _row(nid: str, role: str, rank: str) -> str:
         s = series.get(nid) or {}
@@ -290,12 +305,18 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
         q_p99 = p99.get("server.apply_queue.n", 0.0)
         burning = ",".join(h.get("burning") or []) or "-"
         score = h.get("score")
+        # the audit column: violations attributed to this node's event
+        # stream; "ok" beats a zero so a clean column reads as a verdict
+        an = audit_nodes.get(nid) or {}
+        viol = int(an.get("violations") or 0)
+        audit_cell = str(viol) if viol else ("ok" if an else "-")
         return (
             f"{nid:>5} {role:<10} "
             f"{rank:>4} {push_rate:>9.1f} "
             f"{pull_rate:>9.1f} {shed_rate:>8.1f} {p99_push:>9.2f} "
             f"{q_p99:>7.0f} "
-            f"{(str(score) if score is not None else '-'):>7}  {burning}"
+            f"{(str(score) if score is not None else '-'):>7} "
+            f"{audit_cell:>6}  {burning}"
         )
 
     for nid in sorted(nodes, key=lambda x: int(x)):
@@ -318,6 +339,16 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
             )
     else:
         lines.append("no active SLO alerts")
+    total_viol = int(audit.get("total") or 0)
+    if total_viol:
+        lines.append("")
+        lines.append(f"AUDIT VIOLATIONS ({total_viol}):")
+        for v in (audit.get("recent") or [])[-5:]:
+            lines.append(
+                format_violation(v, exclude=("kind", "monitor", "at"))
+            )
+    elif audit:
+        lines.append("audit: no protocol violations")
     heat = (rep.get("merged") or {}).get("key_heat")
     if heat:
         pairs = heat_top(heat, 5)
@@ -334,4 +365,42 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
         for p in prof[:3]:
             tail = ";".join(str(p.get("s", "")).split(";")[-3:])
             lines.append(f"  {p.get('n', 0):>6}  ...{tail}")
+    return "\n".join(lines)
+
+
+def format_audit(rep: dict[str, Any]) -> str:
+    """Render one ``cli audit`` frame from the coordinator's ``audit``
+    reply (utils/auditor.py ``Auditor.summary``): stream accounting per
+    node, violation totals by kind, and the recent-violations panel."""
+    lines = [
+        f"ps audit — {int(rep.get('total') or 0)} violation(s), "
+        f"{int(rep.get('suppressed') or 0)} suppressed (holed stream), "
+        f"{time.strftime('%H:%M:%S')}",
+        "",
+        f"{'node':>6} {'batches':>8} {'events':>8} {'gaps':>5} "
+        f"{'dropped':>8} {'violations':>11}",
+    ]
+    for nk in sorted(rep.get("nodes") or {}):
+        st = rep["nodes"][nk]
+        lines.append(
+            f"{nk:>6} {st.get('batches', 0):>8} {st.get('events', 0):>8} "
+            f"{st.get('gaps', 0):>5} {st.get('dropped', 0):>8} "
+            f"{st.get('violations', 0):>11}"
+        )
+    by_kind = rep.get("by_kind") or {}
+    if by_kind:
+        lines.append("")
+        lines.append("violations by kind:")
+        for kind in sorted(by_kind):
+            lines.append(f"  {kind:<28} {by_kind[kind]}")
+        lines.append("")
+        lines.append("recent:")
+        for v in rep.get("recent") or []:
+            lines.append(format_violation(v))
+    else:
+        lines.append("")
+        lines.append(
+            "no protocol violations — monitors armed: "
+            + ", ".join(rep.get("monitors") or [])
+        )
     return "\n".join(lines)

@@ -380,6 +380,22 @@ def _fmt(v: float) -> str:
     return repr(round(float(v), 9)) if isinstance(v, float) else str(v)
 
 
+def build_info(proc: str = "") -> dict[str, str]:
+    """The ``ps_build_info`` labels: package version plus the role/rank
+    parsed from the process name (``worker-3`` -> worker/3 — the naming
+    convention every armed plane shares)."""
+    import parameter_server_tpu
+
+    role, _, rank = (proc or "").rpartition("-")
+    if not role or not rank.isdigit():
+        role, rank = proc, ""
+    return {
+        "version": getattr(parameter_server_tpu, "__version__", "0"),
+        "role": role,
+        "rank": rank,
+    }
+
+
 def render_openmetrics(
     snap: dict[str, Any], proc: str = ""
 ) -> str:
@@ -387,11 +403,29 @@ def render_openmetrics(
     snapshot: counters (``_total``), ``*_peak`` gauges, histograms with
     cumulative ``le`` buckets at the log2 microsecond edges (exposed in
     seconds; ``.n`` count series in raw values), timers as two counters,
-    ``# EOF`` terminator."""
+    ``# EOF`` terminator.
+
+    Two series are emitted UNCONDITIONALLY (the tier-1 format validator
+    requires them): ``ps_build_info`` (the Prometheus info-metric idiom
+    — constant 1 with version/role/rank labels, what dashboards join
+    on) and ``ps_audit_violations_total`` (ISSUE 14: a clean cluster
+    scrapes an explicit 0, so "no violations" and "audit plane absent"
+    are different observations)."""
     label = f'{{proc="{proc}"}}' if proc else ""
     lines: list[str] = []
-    for name in sorted(snap.get("counters") or {}):
-        v = snap["counters"][name]
+    info = build_info(proc)
+    info_labels = ",".join(
+        f'{k}="{v}"' for k, v in sorted(info.items())
+    )
+    if proc:
+        info_labels = f'proc="{proc}",' + info_labels
+    lines.append("# TYPE ps_build_info gauge")
+    lines.append(f"ps_build_info{{{info_labels}}} 1")
+    counters = dict(snap.get("counters") or {})
+    # always-present audit verdict counter (0 until a violation fires)
+    counters.setdefault("audit_violations", 0)
+    for name in sorted(counters):
+        v = counters[name]
         m = _metric_name(name)
         if name.endswith("_peak"):
             lines.append(f"# TYPE {m} gauge")
@@ -441,7 +475,17 @@ class MetricsServer:
     windowed view: the local ring's rates/p99 summary, so a human or a
     load balancer can read "how is this node doing right now" without
     the coordinator). ``port=0`` binds an ephemeral port (tests);
-    ``.port`` is the bound port either way."""
+    ``.port`` is the bound port either way.
+
+    Port-collision fallback (ISSUE 14 satellite): a requested port
+    already in use — a stale process, two clusters sharing one base
+    port, a host service squatting on the offset — retries the next
+    per-role offsets (``port + 1``, ``port + 2``, ... up to
+    ``fallback_attempts``) instead of killing the node at arm time;
+    telemetry must degrade to a different port, never take the data
+    plane down. The chosen port is logged and served in ``/healthz``
+    (``port`` + ``requested_port``), so a scraper that found nothing
+    at the configured offset can still discover where the node went."""
 
     def __init__(
         self,
@@ -451,10 +495,12 @@ class MetricsServer:
         snapshot_fn: Callable[[], dict[str, Any]] | None = None,
         health_fn: Callable[[], dict[str, Any]] | None = None,
         window_s: float = 60.0,
+        fallback_attempts: int = 8,
     ):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.process_name = process_name
+        self.requested_port = port
         # observe-only snapshots: a scrape must never consume the
         # heartbeat plane's rolled peak windows
         snap_fn = snapshot_fn or (
@@ -463,7 +509,9 @@ class MetricsServer:
         # default health: liveness + the node's own windowed summary
         # over the configured [timeseries] window (the local ring is
         # fed by beat_telemetry / a Roller; _local resolves at call
-        # time so a later reset_local_ring is picked up)
+        # time so a later reset_local_ring is picked up). The bound +
+        # requested ports ride every health doc — the port-collision
+        # fallback's discovery contract.
         hf = health_fn or (
             lambda: {"ok": True, "window": _local.summary(window_s)}
         )
@@ -485,6 +533,8 @@ class MetricsServer:
                         doc = {
                             "proc": outer.process_name,
                             "time": time.time(),
+                            "port": outer.port,
+                            "requested_port": outer.requested_port,
                             **hf(),
                         }
                         body = (json.dumps(doc) + "\n").encode()
@@ -503,11 +553,32 @@ class MetricsServer:
             def log_message(self, *a: Any) -> None:  # stay silent
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        # bind, walking past EADDRINUSE up to fallback_attempts per-role
+        # offsets (ephemeral port 0 never collides: one bind, no walk)
+        import errno
+
+        attempts = max(int(fallback_attempts), 1) if port else 1
+        httpd = None
+        for i in range(attempts):
+            try:
+                httpd = ThreadingHTTPServer((host, port + i), Handler)
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or i == attempts - 1:
+                    raise
+        self._httpd = httpd
         self._httpd.daemon_threads = True
         self.host = host
         self.port = self._httpd.server_address[1]
         self.address = f"{host}:{self.port}"
+        if port and self.port != port:
+            print(
+                f"[metrics] {process_name or 'node'}: port {port} in "
+                f"use, bound {self.port} instead (fallback offset "
+                f"+{self.port - port})",
+                flush=True,
+            )
+        self._closed = False
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             kwargs={"poll_interval": 0.2},
@@ -517,6 +588,9 @@ class MetricsServer:
         self._thread.start()
 
     def close(self) -> None:
+        if self._closed:  # idempotent: the train path's finally may
+            return        # race an explicit close in tests
+        self._closed = True
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5.0)

@@ -1,0 +1,64 @@
+"""Child process for tests/test_audit.py: one "worker" node with the
+audit spool armed that INJECTS two protocol violations into its own
+flight-recorder stream — an acked push nobody ever applies and a forced
+RCU version rollback — then heartbeats through the real
+HeartbeatReporter carry/ack path, so the parent can assert the
+coordinator's streaming auditor flags both within a beat window and
+that `cli audit` / `cli top` surface them.
+
+Usage: python _audit_child_node.py <coordinator host:port>
+"""
+
+from __future__ import annotations
+
+
+def main() -> None:
+    import sys
+    import time
+
+    from parameter_server_tpu.parallel.control import ControlClient
+    from parameter_server_tpu.utils import flightrec
+    from parameter_server_tpu.utils.heartbeat import (
+        HeartbeatReporter,
+        host_stats,
+    )
+    from parameter_server_tpu.utils.timeseries import beat_telemetry
+
+    ctl = ControlClient(sys.argv[1], reconnect_timeout_s=5.0)
+    nid = ctl.register("worker", rank=0)
+    flightrec.configure_spool(4096)
+
+    # the injected wreckage a buggy server/client pair would leave:
+    # (1) a push the client holds an ok ack for that NO apply.commit /
+    # apply.replay anywhere will ever ledger — the exactly-once hole
+    flightrec.record(
+        "rpc.reply", cmd="push", cid="cX", seq="k9", ok=True,
+    )
+    # (2) a same-life RCU version stream going backwards (same nonce
+    # bits, lower counter) — the rollback psmc's rcu spec forbids
+    flightrec.record("rcu.publish", ver=(7 << 40) + 101)
+    flightrec.record("rcu.publish", ver=(7 << 40) + 99)
+
+    class _Sink:
+        """ctl.beat as a reporter sink, with the delivery verdict the
+        spool ack path needs (the _RemoteBeatSink contract)."""
+
+        def beat(self, node_id: int, stats: dict | None = None) -> bool:
+            try:
+                ctl.beat(node_id, stats)
+                return True
+            except Exception:
+                return False
+
+    rep = HeartbeatReporter(
+        _Sink(), nid, 0.1,
+        stats_fn=lambda: {**host_stats(), "telemetry": beat_telemetry()},
+    )
+    rep.start()
+    print("READY", nid, flush=True)
+    while True:
+        time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,707 @@
+"""ISSUE 14 — live audit plane: streaming protocol sentinel over the
+heartbeat event bus.
+
+Covers the tentpole end to end: the flightrec event spool (bounded,
+seq-numbered, saturation-accounted, carried + acked by the heartbeat
+reporter), the shared streaming monitors (one automaton per invariant,
+each with seeded BUGS drills — the mutation-coverage contract), the
+coordinator's Auditor (seq dedup, gap/saturation suppression, the
+audit command + cli top/cli audit surfaces), offline/online parity
+with `cli postmortem`, and the acceptance drill: a REAL 2-process
+cluster where an injected ack-without-apply and a forced RCU rollback
+surface at the coordinator within a beat window.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from parameter_server_tpu.analysis import monitors as monitors_mod
+from parameter_server_tpu.utils import flightrec
+from parameter_server_tpu.utils.auditor import Auditor
+from parameter_server_tpu.utils.config import AuditConfig
+from parameter_server_tpu.utils.metrics import wire_counters
+
+HERE = Path(__file__).resolve().parent
+
+
+def _row(ts, etype, fields, tid=11):
+    return [ts, tid, etype, fields]
+
+
+def _batch(seq, rows, dropped=0):
+    return {"seq": seq, "events": rows, "dropped": dropped}
+
+
+# ---------------------------------------------------------------------------
+# event spool
+# ---------------------------------------------------------------------------
+
+
+class TestEventSpool:
+    def test_record_feeds_spool_and_identity_rebinds(self):
+        assert flightrec.record is flightrec._noop_record
+        flightrec.configure_spool(64)
+        try:
+            assert flightrec.record is not flightrec._noop_record
+            flightrec.record("rpc.reply", cmd="push", cid="c", seq="k0",
+                             ok=True)
+            flightrec.record("rcu.publish", ver=3)
+            flightrec.record("rpc.in", cmd="push", cid="c", seq="k0")  # not audit
+            flightrec.record("rpc.reply", cmd="pull", cid="c", seq=4)  # filtered
+            sp = flightrec.audit_spool()
+            assert len(sp) == 2
+            batches = sp.drain()
+            assert len(batches) == 1 and batches[0]["seq"] == 0
+            etypes = [r[2] for r in batches[0]["events"]]
+            assert etypes == ["rpc.reply", "rcu.publish"]
+        finally:
+            flightrec.configure_spool(None)
+        assert flightrec.record is flightrec._noop_record
+
+    def test_saturation_drops_new_and_counts(self):
+        flightrec.configure_spool(16, batch_events=8)
+        try:
+            d0 = wire_counters.get("audit_spool_dropped")
+            for i in range(40):
+                flightrec.record("rcu.publish", ver=i)
+            sp = flightrec.audit_spool()
+            assert len(sp) == 16  # bounded
+            dropped = wire_counters.get("audit_spool_dropped") - d0
+            assert dropped == 24
+            batches = sp.drain(max_batches=4)
+            # the cut batches carry the cumulative drop watermark
+            assert all(b["dropped"] >= d0 + 24 for b in batches)
+            assert [b["seq"] for b in batches] == [0, 1]
+            # drop-NEW: the retained prefix is the OLDEST events
+            assert batches[0]["events"][0][3]["ver"] == 0
+        finally:
+            flightrec.configure_spool(None)
+
+    def test_unacked_batches_reship_under_same_seq(self):
+        flightrec.configure_spool(64)
+        try:
+            sp = flightrec.audit_spool()
+            flightrec.record("rcu.publish", ver=1)
+            b1 = sp.drain()
+            assert [b["seq"] for b in b1] == [0]
+            # no ack (the beat died): next drain re-ships seq 0 plus
+            # anything newly spooled
+            flightrec.record("rcu.publish", ver=2)
+            b2 = sp.drain()
+            assert [b["seq"] for b in b2] == [0, 1]
+            sp.ack()
+            flightrec.record("rcu.publish", ver=3)
+            b3 = sp.drain()
+            assert [b["seq"] for b in b3] == [2]
+        finally:
+            flightrec.configure_spool(None)
+
+    def test_heartbeat_reporter_carries_and_acks(self):
+        from parameter_server_tpu.utils.heartbeat import HeartbeatReporter
+
+        class FlakySink:
+            def __init__(self):
+                self.stats: list[dict] = []
+                self.fail = True
+
+            def beat(self, node_id, stats):
+                self.stats.append(stats)
+                return not self.fail
+
+        flightrec.configure_spool(64)
+        try:
+            sink = FlakySink()
+            rep = HeartbeatReporter(sink, 7, 999.0, stats_fn=lambda: {})
+            flightrec.record("rcu.publish", ver=1)
+            rep._beat_once()  # carried but delivery failed: stays in flight
+            assert [b["seq"] for b in sink.stats[0]["audit"]] == [0]
+            flightrec.record("rcu.publish", ver=2)
+            rep._beat_once()  # re-ships seq 0 alongside the new batch
+            assert [b["seq"] for b in sink.stats[1]["audit"]] == [0, 1]
+            sink.fail = False
+            rep._beat_once()  # delivered: acked
+            assert [b["seq"] for b in sink.stats[2]["audit"]] == [0, 1]
+            rep._beat_once()  # nothing left to carry
+            assert "audit" not in sink.stats[3]
+        finally:
+            flightrec.configure_spool(None)
+
+
+# ---------------------------------------------------------------------------
+# monitors: the mutation-coverage contract + healthy-stream negatives
+# ---------------------------------------------------------------------------
+
+
+class TestMonitorContract:
+    def test_every_registered_monitor_declares_a_seeded_drill(self):
+        """CI/tooling satellite: a monitor with no BUGS drill fails
+        tier-1 — a detector that never demonstrated catching its bug
+        class is assumed blind (the psmc BUGS discipline)."""
+        assert monitors_mod.MONITORS, "empty registry"
+        for name, cls in monitors_mod.MONITORS.items():
+            assert cls.BUGS, f"monitor {name!r} declares no seeded drill"
+
+    def test_every_seeded_drill_is_caught(self):
+        for name, cls in monitors_mod.MONITORS.items():
+            for bug in cls.BUGS:
+                out, expected = monitors_mod.run_bug(cls, bug)
+                kinds = [v["kind"] for v in out]
+                assert expected in kinds, (name, bug, out)
+
+    def test_registry_events_match_flightrec_audit_set(self):
+        """Everything a monitor consumes must be spool-admissible, or
+        the live plane feeds it nothing (the offline plane would still
+        see it — exactly the drift this pin kills)."""
+        assert monitors_mod.monitor_events() <= flightrec.AUDIT_EVENTS
+
+    def test_monitor_names_are_the_registry_keys(self):
+        for name, cls in monitors_mod.MONITORS.items():
+            assert cls.name == name
+
+
+class TestMonitorNegatives:
+    def test_ack_then_commit_and_commit_then_ack_both_clean(self):
+        for order in ((0, 1), (1, 0)):
+            m = monitors_mod.AckAppliedMonitor(watermark_s=5.0)
+            evs = [
+                monitors_mod._ev(0.1, "w", "rpc.reply",
+                                 {"cmd": "push", "cid": "c", "seq": "k0",
+                                  "ok": True}),
+                monitors_mod._ev(0.2, "s", "apply.commit",
+                                 {"ver": 2, "pairs": [["c", "k0"]]}),
+            ]
+            out = []
+            for i in order:
+                out += m.feed(evs[i])
+            out += m.finish()
+            assert out == [], order
+
+    def test_replay_dedup_is_not_a_double_apply(self):
+        m = monitors_mod.AckAppliedMonitor(watermark_s=5.0)
+        out = m.feed(monitors_mod._ev(
+            0.1, "s", "apply.commit", {"ver": 2, "pairs": [["c", "k0"]]}
+        ))
+        out += m.feed(monitors_mod._ev(
+            0.2, "s", "apply.replay", {"cid": "c", "seq": "k0"}
+        ))
+        # a duplicate ack after resolution is chaos, not a violation
+        out += m.feed(monitors_mod._ev(
+            0.3, "w", "rpc.reply",
+            {"cmd": "push", "cid": "c", "seq": "k0", "ok": True},
+        ))
+        out += m.feed(monitors_mod._ev(
+            0.4, "w", "rpc.reply",
+            {"cmd": "push", "cid": "c", "seq": "k0", "ok": True},
+        ))
+        out += m.finish()
+        assert out == []
+
+    def test_rcu_new_life_nonce_is_not_a_regression(self):
+        m = monitors_mod.RcuMonitor()
+        hi = monitors_mod.RcuMonitor.NONCE_SHIFT
+        out = m.feed(monitors_mod._ev(
+            0.1, "s", "rcu.publish", {"ver": (9 << hi) + 100}
+        ))
+        # a restarted server instance draws a new nonce; its counter
+        # restarts low — NOT a rollback of the previous life
+        out += m.feed(monitors_mod._ev(
+            0.2, "s", "rcu.publish", {"ver": (3 << hi) + 1}
+        ))
+        assert out == []
+
+    def test_ssp_within_bound_and_unknown_bound_clean(self):
+        m = monitors_mod.SspMonitor(max_delay=1, num_workers=2)
+        for w in (0, 1):
+            m.feed(monitors_mod._ev(
+                0.1, "c", "ssp.finish", {"worker": w, "step": 6}
+            ))
+        out = m.feed(monitors_mod._ev(
+            0.2, "c", "ssp.wait", {"worker": 0, "step": 8, "granted": True}
+        ))
+        out += m.finish()
+        assert out == []
+        # dormant without a bound (offline dumps don't carry max_delay)
+        m2 = monitors_mod.SspMonitor()
+        m2.feed(monitors_mod._ev(
+            0.2, "c", "ssp.wait", {"worker": 0, "step": 99, "granted": True}
+        ))
+        assert m2.finish() == []
+
+    def test_ssp_late_justifying_finish_retracts_the_suspect(self):
+        """The clock records outside its lock: the enabling finish may
+        trail the granted wait in the stream — a suspect, not a
+        violation, until the grace window closes."""
+        m = monitors_mod.SspMonitor(max_delay=1, num_workers=2, grace_s=5.0)
+        m.feed(monitors_mod._ev(
+            0.0, "c", "ssp.finish", {"worker": 0, "step": 9}
+        ))
+        m.feed(monitors_mod._ev(
+            0.1, "c", "ssp.wait", {"worker": 0, "step": 9, "granted": True}
+        ))
+        # the reordered finish that actually opened the gate
+        m.feed(monitors_mod._ev(
+            0.2, "c", "ssp.finish", {"worker": 1, "step": 8}
+        ))
+        assert m.finish() == []
+
+    def test_heal_that_lands_is_clean(self):
+        m = monitors_mod.HealMonitor(heal_timeout_s=1.0)
+        m.feed(monitors_mod._ev(0.1, "w", "rpc.heal.begin", {"cid": "c"}))
+        m.feed(monitors_mod._ev(0.3, "w", "rpc.healed",
+                                {"cid": "c", "resent": 2}))
+        assert m.finish() == []
+
+    def test_shed_trickle_is_not_a_storm(self):
+        m = monitors_mod.ShedStormMonitor(n=10, window_s=1.0)
+        out = []
+        for i in range(12):
+            out += m.feed(monitors_mod._ev(
+                1.0 + i * 0.5, "s", "serve.shed", {"sig": "x"}
+            ))
+        assert out == []
+
+    def test_cross_node_beat_skew_is_not_a_storm(self):
+        """Review fix: the live feeder interleaves per-node streams in
+        ARRIVAL order — node B's newer sheds can land before node A's
+        older ones. Two sub-threshold bursts > window_s apart in event
+        time must not pool into a false storm."""
+        m = monitors_mod.ShedStormMonitor(n=10, window_s=1.0)
+        out = []
+        for i in range(5):  # node B's beat arrives first: ts ~11.5
+            out += m.feed(monitors_mod._ev(
+                11.5 + i * 0.01, "B", "serve.shed", {"sig": "x"}
+            ))
+        for i in range(5):  # node A's delayed beat: ts ~10.0
+            out += m.feed(monitors_mod._ev(
+                10.0 + i * 0.01, "A", "serve.shed", {"sig": "x"}
+            ))
+        assert out == []
+        # a REAL storm split across skewed arrivals still fires
+        m2 = monitors_mod.ShedStormMonitor(n=10, window_s=1.0)
+        out2 = []
+        for i in range(5):
+            out2 += m2.feed(monitors_mod._ev(
+                10.5 + i * 0.01, "B", "serve.shed", {"sig": "x"}
+            ))
+        for i in range(5):
+            out2 += m2.feed(monitors_mod._ev(
+                10.0 + i * 0.01, "A", "serve.shed", {"sig": "x"}
+            ))
+        assert [v["kind"] for v in out2] == ["shed-storm"]
+
+    def test_large_batch_commit_pairs_all_pair(self):
+        """Review fix: apply.commit ships the FULL batch's pairs (no
+        64-entry slice) — 100 acked pushes in one coalesced commit must
+        all resolve, or max_batch > 64 pages a healthy cluster."""
+        m = monitors_mod.AckAppliedMonitor(watermark_s=1.0)
+        pairs = [[f"c{i}", "k0"] for i in range(100)]
+        out = m.feed(monitors_mod._ev(
+            0.1, "s", "apply.commit", {"ver": 2, "pairs": pairs}
+        ))
+        for i in range(100):
+            out += m.feed(monitors_mod._ev(
+                0.2, "w", "rpc.reply",
+                {"cmd": "push", "cid": f"c{i}", "seq": "k0", "ok": True},
+            ))
+        out += m.finish()
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# the auditor
+# ---------------------------------------------------------------------------
+
+
+class TestAuditor:
+    def test_seq_dedup_drops_reshipped_batches(self):
+        a = Auditor(AuditConfig())
+        rows = [_row(1.0, "rcu.publish", {"ver": 100})]
+        a.ingest(3, [_batch(0, rows)], now=10.0)
+        a.ingest(3, [_batch(0, rows)], now=11.0)  # re-shipped: dup
+        st = a.summary()["nodes"]["3"]
+        assert st["batches"] == 1 and st["events"] == 1
+        assert a.summary()["total"] == 0
+
+    def test_holed_server_stream_suppresses_ack_verdicts(self):
+        a = Auditor(AuditConfig(watermark_s=1.0))
+        s0 = wire_counters.get("audit_suppressed")
+        ack = _row(1.0, "rpc.reply",
+                   {"cmd": "push", "cid": "c", "seq": "k0", "ok": True})
+        a.ingest(3, [_batch(0, [ack])], now=10.0, role="worker")
+        # the SERVER stream (where the missing commit would live) has a
+        # seq gap: its spool lost batches in between
+        a.ingest(4, [_batch(0, [_row(2.0, "rcu.publish", {"ver": 9})])],
+                 now=10.2, role="server")
+        a.ingest(4, [_batch(4, [_row(2.1, "rcu.publish", {"ver": 10})])],
+                 now=10.5, role="server")
+        assert a.summary()["nodes"]["4"]["gaps"] == 1
+        a.flush(now=12.0)  # watermark expired, but the stream is holed
+        rep = a.summary()
+        assert rep["total"] == 0 and rep["suppressed"] == 1
+        assert wire_counters.get("audit_suppressed") == s0 + 1
+
+    def test_holed_worker_stream_does_not_blind_the_cluster(self):
+        """Review fix: suppression targets the stream that could hold
+        the MISSING half. A busy worker saturating its own spool never
+        hides an acked-but-unapplied whose commit should live in a
+        clean server stream — the ack itself is surviving evidence."""
+        a = Auditor(AuditConfig(watermark_s=1.0))
+        ack = _row(1.0, "rpc.reply",
+                   {"cmd": "push", "cid": "c", "seq": "k0", "ok": True})
+        a.ingest(3, [_batch(0, [ack], dropped=50)], now=10.0,
+                 role="worker")  # the acking node's OWN stream is holed
+        a.ingest(4, [_batch(0, [_row(2.0, "rcu.publish", {"ver": 9})])],
+                 now=10.0, role="server")  # the server stream is clean
+        # watermark (1 s) expired, hole window (2 s) still open
+        a.flush(now=11.5)
+        rep = a.summary()
+        assert rep["by_kind"] == {"acked-but-unapplied": 1}
+        assert rep["suppressed"] == 0
+        assert rep["holed"] == ["3"]
+
+    def test_self_contained_verdicts_survive_holes(self):
+        a = Auditor(AuditConfig(watermark_s=1.0))
+        rows = [
+            _row(1.0, "rcu.publish", {"ver": 101}),
+            _row(1.1, "rcu.publish", {"ver": 99}),
+        ]
+        # dropped watermark nonzero: a holed stream — but a version
+        # regression inside the retained slice is still a hard fact
+        a.ingest(3, [_batch(0, rows, dropped=7)], now=10.0)
+        rep = a.summary()
+        assert rep["by_kind"] == {"version-regression": 1}
+        assert rep["nodes"]["3"]["dropped"] == 7
+
+    def test_all_monitor_kinds_through_one_auditor(self):
+        """Every registered monitor catches its bug class through the
+        REAL ingest path (batches -> normalize -> feed -> finish)."""
+        a = Auditor(AuditConfig(
+            watermark_s=1.0, heal_timeout_s=1.0, shed_storm_n=10,
+            shed_storm_window_s=1.0,
+        ))
+        a.set_ssp(num_workers=2, max_delay=1)
+        rows = [
+            _row(1.0, "rpc.reply",
+                 {"cmd": "push", "cid": "cA", "seq": "k0", "ok": True}),
+            _row(1.1, "apply.commit", {"ver": 2, "pairs": [["cB", "k1"]]}),
+            _row(1.2, "apply.commit", {"ver": 3, "pairs": [["cB", "k1"]]}),
+            _row(1.3, "rcu.publish", {"ver": 101}),
+            _row(1.4, "rcu.publish", {"ver": 99}),
+            _row(1.5, "ssp.finish", {"worker": 0, "step": 9}),
+            _row(1.6, "ssp.wait", {"worker": 0, "step": 9, "granted": True}),
+            _row(1.7, "rpc.heal.begin", {"cid": "cA"}),
+        ] + [
+            _row(2.0 + i * 0.01, "serve.shed", {"sig": "x"})
+            for i in range(12)
+        ]
+        v0 = wire_counters.get("audit_violations")
+        a.ingest("n1", [_batch(0, rows)], now=100.0)
+        a.finish(now=200.0)
+        rep = a.summary(recent=50)
+        assert set(rep["by_kind"]) == {
+            "acked-but-unapplied", "double-applied", "version-regression",
+            "ssp-staleness", "reconnect-without-heal", "shed-storm",
+        }
+        assert rep["total"] == 6
+        assert wire_counters.get("audit_violations") == v0 + 6
+        assert rep["nodes"]["n1"]["violations"] == 6
+
+    def test_violations_reach_the_flight_recorder(self, tmp_path):
+        flightrec.configure(
+            str(tmp_path), process_name="aud-0",
+            flush_interval_s=0, watchdog_interval_s=3600,
+        )
+        try:
+            a = Auditor(AuditConfig())
+            a.ingest("n1", [_batch(0, [
+                _row(1.0, "rcu.publish", {"ver": 101}),
+                _row(1.1, "rcu.publish", {"ver": 99}),
+            ])], now=10.0)
+            evs = [e for e in flightrec.events() if e[2] == "audit.violation"]
+            assert len(evs) == 1
+            assert evs[0][3]["kind"] == "version-regression"
+            assert evs[0][3]["node"] == "n1"
+        finally:
+            flightrec.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# offline/online parity (acceptance): same stream => same anomaly set
+# ---------------------------------------------------------------------------
+
+
+def _parity_stream():
+    """One event stream with four induced anomalies, as (proc, pid,
+    rows) triplets: an acked-unapplied push, an RCU rollback, a heal
+    that never lands, a shed storm."""
+    client = [
+        _row(1.2, "rpc.reply",
+             {"cmd": "push", "cid": "c1", "seq": "k0", "ok": True}),
+        _row(2.0, "rpc.heal.begin", {"addr": "a", "cid": "c1"}),
+        _row(2.5, "rpc.heal.failed", {"addr": "a", "cid": "c1"}),
+    ]
+    server = [
+        # evidence row: the postmortem's gate needs a surviving server
+        # box that saw this cid (the live plane needs no such gate —
+        # its stream is complete by construction, so its spool simply
+        # never ships rpc.in)
+        _row(1.1, "rpc.in", {"cmd": "push", "cid": "c1", "seq": "k0"}),
+        _row(3.0, "rcu.publish", {"ver": 101}),
+        _row(3.1, "rcu.publish", {"ver": 99}),
+    ] + [
+        _row(4.0 + i * 0.01, "serve.shed", {"sig": "s"}) for i in range(12)
+    ]
+    return client, server
+
+
+_PARITY_KINDS = {
+    "acked-but-unapplied", "version-regression",
+    "reconnect-without-heal", "shed-storm",
+}
+
+
+class TestOfflineOnlineParity:
+    def test_postmortem_and_auditor_flag_the_same_set(self):
+        from parameter_server_tpu.utils import postmortem as pm
+
+        client, server = _parity_stream()
+
+        def mk(proc, pid, rows):
+            return {
+                "schema": "psbb/1", "process": proc, "pid": pid,
+                "reason": "exit", "trigger_reasons": ["exit"],
+                "wall_time": 0.0, "events": rows, "telemetry": {},
+                "threads": [], "stall": None,
+            }
+
+        dumps = [mk("worker-0", 1, client), mk("server-0", 2, server)]
+        tl = pm.merge_timeline(dumps)
+        offline = {
+            a["kind"] for a in pm.find_anomalies(dumps, tl)
+        }
+        assert offline == _PARITY_KINDS
+
+        a = Auditor(AuditConfig(watermark_s=1.0, heal_timeout_s=1.0))
+        # the live bus ships the audit-relevant slice only (no rpc.in)
+        a.ingest(1, [_batch(0, [r for r in client])], now=10.0)
+        a.ingest(2, [_batch(0, [
+            r for r in server if r[2] != "rpc.in"
+        ])], now=10.0)
+        a.finish(now=100.0)
+        online = set(a.summary(recent=50)["by_kind"])
+        assert online == offline == _PARITY_KINDS
+
+    def test_postmortem_renders_live_auditor_verdicts(self):
+        """A cluster that ran with the audit plane armed leaves the
+        sentinel's own verdicts in the coordinator's box — the
+        postmortem replays them as [audit-violation] anomalies."""
+        from parameter_server_tpu.utils import postmortem as pm
+
+        coord = {
+            "schema": "psbb/1", "process": "scheduler-0", "pid": 9,
+            "reason": "exit", "trigger_reasons": ["exit"],
+            "wall_time": 0.0, "telemetry": {}, "threads": [],
+            "stall": None,
+            "events": [_row(5.0, "audit.violation", {
+                "kind": "acked-but-unapplied", "monitor": "ack-applied",
+                "node": "3", "cid": "c1", "seq": "k0",
+            })],
+        }
+        tl = pm.merge_timeline([coord])
+        an = pm.find_anomalies([coord], tl)
+        hits = [a for a in an if a["kind"] == "audit-violation"]
+        assert hits and hits[0]["violation"] == "acked-but-unapplied"
+        assert hits[0]["cid"] == "c1"
+
+
+# ---------------------------------------------------------------------------
+# coordinator integration + the acceptance drill
+# ---------------------------------------------------------------------------
+
+
+class TestCoordinatorAudit:
+    def test_beat_batches_reach_the_auditor_and_dedup(self):
+        from parameter_server_tpu.parallel.control import (
+            ControlClient,
+            Coordinator,
+        )
+
+        coord = Coordinator(audit_cfg=AuditConfig(watermark_s=0.2))
+        ctl = ControlClient(coord.address)
+        try:
+            nid = ctl.register("server", rank=0)
+            rows = [
+                _row(1.0, "rcu.publish", {"ver": 101}),
+                _row(1.1, "rcu.publish", {"ver": 99}),
+            ]
+            ctl.beat(nid, {"audit": [_batch(0, rows)]})
+            ctl.beat(nid, {"audit": [_batch(0, rows)]})  # re-ship: dup
+            rep = ctl.audit()
+            assert rep["total"] == 1
+            assert rep["by_kind"] == {"version-regression": 1}
+            st = rep["nodes"][str(nid)]
+            assert st["batches"] == 1 and st["violations"] == 1
+            # the telemetry reply carries the same block for cli top
+            tel = ctl.telemetry()
+            assert tel["audit"]["total"] == 1
+            # latest_stats keeps the telemetry contract: the event bus
+            # is not retained as a point sample
+            assert "audit" not in coord._monitor.latest_stats()[nid]
+        finally:
+            ctl.close()
+            coord.stop()
+
+    def test_ssp_init_teaches_the_monitor_its_bound(self):
+        from parameter_server_tpu.parallel.control import (
+            ControlClient,
+            Coordinator,
+        )
+
+        coord = Coordinator(audit_cfg=AuditConfig(watermark_s=0.2))
+        ctl = ControlClient(coord.address)
+        try:
+            nid = ctl.register("worker", rank=0)
+            ctl.ssp_init(num_workers=2, max_delay=1)
+            rows = [
+                _row(1.0, "ssp.finish", {"worker": 0, "step": 9}),
+                _row(1.1, "ssp.wait",
+                     {"worker": 0, "step": 9, "granted": True}),
+            ]
+            ctl.beat(nid, {"audit": [_batch(0, rows)]})
+            deadline = time.monotonic() + 10.0
+            rep = ctl.audit()
+            while (
+                not rep["total"] and time.monotonic() < deadline
+            ):
+                time.sleep(0.2)
+                rep = ctl.audit()
+            assert rep["by_kind"].get("ssp-staleness") == 1, rep
+        finally:
+            ctl.close()
+            coord.stop()
+
+
+class TestLiveAuditDrill:
+    def test_injected_violations_surface_within_a_beat_window(
+        self, capsys
+    ):
+        """Acceptance: a REAL child node with the spool armed injects
+        an acked-but-unapplied push and a forced RCU rollback; the
+        coordinator's auditor flags both within a heartbeat window and
+        `cli audit` / `cli top` surface them."""
+        import os
+
+        from parameter_server_tpu.cli import main as cli_main
+        from parameter_server_tpu.parallel.control import Coordinator
+
+        coord = Coordinator(audit_cfg=AuditConfig(watermark_s=1.0))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (
+            str(HERE.parent) + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        child = subprocess.Popen(
+            [
+                sys.executable, str(HERE / "_audit_child_node.py"),
+                coord.address,
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        try:
+            line = child.stdout.readline()
+            assert line.startswith("READY"), (
+                line,
+                (child.stderr.read() or "")[-800:]
+                if child.poll() is not None else "",
+            )
+            # both violations must land: the rollback on the first
+            # ingested beat, the unpaired ack once the 1 s watermark
+            # expires — well inside a couple of beat windows
+            deadline = time.monotonic() + 20.0
+            rep = None
+            while time.monotonic() < deadline:
+                rep = coord._auditor.summary(recent=10)
+                if rep["total"] >= 2:
+                    break
+                coord._audit_pass()
+                time.sleep(0.1)
+            assert rep and rep["total"] >= 2, rep
+            assert set(rep["by_kind"]) == {
+                "acked-but-unapplied", "version-regression",
+            }, rep
+            # the violation detail survives to the panel
+            kinds = {v["kind"]: v for v in rep["recent"]}
+            assert kinds["acked-but-unapplied"]["cid"] == "cX"
+            assert kinds["version-regression"]["to"] == (7 << 40) + 99
+
+            # cli audit --once: summary + nonzero exit for CI gates
+            rc = cli_main([
+                "audit", "--scheduler", coord.address, "--once",
+            ])
+            assert rc == 1
+            out = capsys.readouterr().out
+            assert "ps audit" in out
+            assert "acked-but-unapplied" in out
+            assert "version-regression" in out
+
+            # cli top --once: the audit column counts the node's
+            # violations next to its health
+            rc = cli_main([
+                "top", "--scheduler", coord.address, "--once",
+            ])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "AUDIT VIOLATIONS" in out
+            row = next(
+                ln for ln in out.splitlines() if " worker " in ln
+            )
+            assert row.split()[9] == "2"  # the audit column
+        finally:
+            child.kill()
+            child.wait(timeout=10)
+            child.stdout.close()
+            child.stderr.close()
+            coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# cli audit --json / follow plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestCliAudit:
+    def test_json_one_shot_schema(self, capsys):
+        from parameter_server_tpu.cli import main as cli_main
+        from parameter_server_tpu.parallel.control import (
+            ControlClient,
+            Coordinator,
+        )
+
+        coord = Coordinator(audit_cfg=AuditConfig())
+        ctl = ControlClient(coord.address)
+        try:
+            nid = ctl.register("server", rank=0)
+            ctl.beat(nid, {"audit": [_batch(0, [
+                _row(1.0, "rcu.publish", {"ver": 101}),
+                _row(1.1, "rcu.publish", {"ver": 99}),
+            ])]})
+            rc = cli_main([
+                "audit", "--scheduler", coord.address, "--json",
+            ])
+            assert rc == 1
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["total"] == 1
+            assert doc["by_kind"] == {"version-regression": 1}
+            assert str(nid) in doc["nodes"]
+            assert doc["recent"][0]["kind"] == "version-regression"
+            assert "monitors" in doc
+        finally:
+            ctl.close()
+            coord.stop()

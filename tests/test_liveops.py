@@ -120,8 +120,12 @@ class TestTimeSeriesRing:
 
 def validate_openmetrics(text: str) -> dict[str, str]:
     """Minimal OpenMetrics validator: returns {family: type}. Asserts
-    the EOF terminator, name grammar, counter ``_total`` suffixes and
-    histogram bucket coherence (cumulative, +Inf == count)."""
+    the EOF terminator, name grammar, counter ``_total`` suffixes,
+    histogram bucket coherence (cumulative, +Inf == count), and the
+    ISSUE 14 always-present series — ``ps_build_info`` (info-metric
+    gauge with version/role/rank labels) and
+    ``ps_audit_violations_total`` (explicit 0 on a clean node, so "no
+    violations" and "audit plane absent" scrape differently)."""
     lines = text.splitlines()
     assert lines, "empty exposition"
     assert lines[-1] == "# EOF", "must end with the EOF terminator"
@@ -182,6 +186,15 @@ def validate_openmetrics(text: str) -> dict[str, str]:
         assert counts == sorted(counts), f"{fam} buckets not cumulative"
         total = next(v for n, _, v in samples if n == fam + "_count")
         assert les[-1][1] == total, f"{fam} +Inf bucket != count"
+    # the always-present series (ISSUE 14 satellite)
+    assert types.get("ps_build_info") == "gauge"
+    info = next(
+        (labels, v) for n, labels, v in samples if n == "ps_build_info"
+    )
+    assert 'version="' in info[0] and 'role="' in info[0], info
+    assert info[1] == 1.0
+    assert types.get("ps_audit_violations") == "counter"
+    assert any(n == "ps_audit_violations_total" for n, _, _ in samples)
     return types
 
 
@@ -663,3 +676,174 @@ class TestShedStormDrill:
         assert slo_anoms[0]["rule"] == "shed_rate"
         assert "slo-alert" in pm["report"]
         assert pm["unknown_events"] == {}
+
+
+class TestBuildInfoAndAuditMetric:
+    def test_build_info_labels_parse_role_rank(self):
+        info = timeseries.build_info("worker-3")
+        assert info["role"] == "worker" and info["rank"] == "3"
+        assert info["version"]
+        # a non role-rank name keeps the whole name as the role
+        info = timeseries.build_info("train")
+        assert info["role"] == "train" and info["rank"] == ""
+
+    def test_series_present_even_on_a_virgin_snapshot(self):
+        text = timeseries.render_openmetrics(
+            {"counters": {}, "hists": {}, "timers": {}}, proc="server-1"
+        )
+        types = validate_openmetrics(text)
+        assert types["ps_audit_violations"] == "counter"
+        assert 'ps_build_info{proc="server-1"' in text
+        assert 'role="server"' in text and 'rank="1"' in text
+        assert "ps_audit_violations_total" in text
+
+
+class TestMetricsPortFallback:
+    def test_collision_walks_to_the_next_offset(self):
+        import socket as socket_mod
+
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            base = s.getsockname()[1]
+        # the port freed just now: claim it, then collide on purpose
+        s1 = timeseries.start_metrics_server(base, process_name="a-0")
+        s2 = None
+        try:
+            assert s1.port == base
+            s2 = timeseries.start_metrics_server(base, process_name="b-0")
+            assert s2.port == base + 1  # the next per-role offset
+            assert s2.requested_port == base
+            # /healthz serves the chosen + requested ports (discovery)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{s2.port}/healthz", timeout=10
+            ) as resp:
+                doc = json.loads(resp.read().decode())
+            assert doc["port"] == base + 1
+            assert doc["requested_port"] == base
+        finally:
+            s1.close()
+            if s2 is not None:
+                s2.close()
+
+    def test_exhausted_attempts_still_raise(self):
+        import socket as socket_mod
+
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            base = s.getsockname()[1]
+        servers = [
+            timeseries.MetricsServer(base + i, fallback_attempts=1)
+            for i in range(2)
+        ]
+        try:
+            with pytest.raises(OSError):
+                timeseries.MetricsServer(base, fallback_attempts=2)
+        finally:
+            for srv in servers:
+                srv.close()
+
+
+class TestShutdownIdempotence:
+    """ISSUE 14 satellite: the live-ops service objects' close paths
+    are re-entrant and re-armable — the `cli train` finally block (and
+    any test teardown) may run them twice or re-arm after closing."""
+
+    def test_metrics_server_double_close(self):
+        srv = timeseries.start_metrics_server(0, process_name="x-0")
+        srv.close()
+        srv.close()  # idempotent: no shutdown() hang, no double-close
+
+    def test_roller_double_close_and_rearm(self):
+        r = timeseries.Roller(999.0)
+        r.close()
+        r.close()
+        r2 = timeseries.Roller(999.0)  # arm-after-close: fresh thread
+        assert r2._thread.is_alive()
+        r2.close()
+        assert not r2._thread.is_alive()
+
+    def test_profiler_double_disarm_and_rearm(self):
+        profiler.configure(0)
+        profiler.configure(0)  # double disarm
+        assert profiler.top_stacks is profiler._noop_top_stacks
+        p = profiler.configure(100, process_name="idem-0")
+        assert p is not None and profiler.enabled()
+        profiler.configure(0)
+        profiler.configure(0)
+        assert profiler.top_stacks is profiler._noop_top_stacks
+        # arm-after-close works and leaves no stray sampler behind
+        p2 = profiler.configure(100, process_name="idem-1")
+        assert profiler.current() is p2
+        profiler.configure(0)
+        assert profiler.current() is None
+
+    def test_no_ps_service_threads_survive_the_train_finally(self):
+        """The conftest leak check now also fails tests that leave
+        ps-ts-roller / ps-metrics / ps-profiler daemons behind; drive
+        the arm/close cycle the `cli train` finally block performs and
+        assert the named threads are really gone."""
+        srv = timeseries.start_metrics_server(0, process_name="t-0")
+        roller = timeseries.Roller(999.0)
+        profiler.configure(100, process_name="t-0")
+        try:
+            names = {t.name for t in threading.enumerate()}
+            assert "ps-metrics" in names
+            assert "ps-ts-roller" in names
+            assert "ps-profiler" in names
+        finally:
+            roller.close()
+            srv.close()
+            profiler.configure(0)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            names = {t.name for t in threading.enumerate()}
+            if not names & {"ps-metrics", "ps-ts-roller", "ps-profiler"}:
+                break
+            time.sleep(0.05)
+        assert not names & {"ps-metrics", "ps-ts-roller", "ps-profiler"}
+
+
+class TestTopJson:
+    def test_one_shot_schema_contract(self, capsys):
+        """`cli top --json` (ISSUE 14 satellite): the machine-readable
+        frame carries the same blocks the dashboard renders, under a
+        stable schema CI and scripts can key on."""
+        from parameter_server_tpu.cli import main as cli_main
+        from parameter_server_tpu.parallel.control import (
+            ControlClient,
+            Coordinator,
+        )
+
+        coord = Coordinator()
+        ctl = ControlClient(coord.address)
+        try:
+            nid = ctl.register("worker", rank=0)
+            for i in range(3):
+                ctl.beat(nid, {"telemetry": _snap(
+                    {"wire_bytes_out": 1000 * (i + 1)}
+                )})
+                time.sleep(0.05)
+            rc = cli_main([
+                "top", "--scheduler", coord.address, "--json",
+                "--window", "30",
+            ])
+            assert rc == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert set(doc) == {
+                "window_s", "nodes", "series", "health", "alerts", "audit",
+            }
+            assert doc["window_s"] == 30.0
+            assert str(nid) in doc["nodes"]
+            assert doc["nodes"][str(nid)]["role"] == "worker"
+            # series block: the same windowed summary cli top renders
+            s = doc["series"][str(nid)]
+            assert {"rates", "p50", "p99", "hist_rates"} <= set(s)
+            assert s["rates"].get("wire_bytes_out", 0) > 0
+            assert isinstance(doc["alerts"], list)
+            assert doc["health"][str(nid)]["score"] == 100
+            # audit block present (clean cluster: zero violations)
+            assert doc["audit"]["total"] == 0
+            assert doc["audit"]["monitors"]
+        finally:
+            ctl.close()
+            coord.stop()
