@@ -1335,18 +1335,24 @@ def child_wire_rpc() -> dict:
         # profiler ALL armed within 5% of all-off; ISSUE 14 extends the
         # armed side with the audit event spool — every push's
         # issue/reply now also passes the spool's admission filter, the
-        # exact cost a live-audited production node pays). The roller
+        # exact cost a live-audited production node pays; ISSUE 15 adds
+        # head-sampled tracing at sample=16 WITH tail capture, so the
+        # always-on slow-trace retention — pending buffers, promotion
+        # checks, limbo ring — is inside the same ratio). The roller
         # runs far above its production cadence (0.1 s vs one roll per
         # heartbeat) and the profiler at its default Hz, so this is a
         # conservative ceiling on what a fully-instrumented node pays.
         from parameter_server_tpu.utils import profiler as prof_mod
         from parameter_server_tpu.utils import timeseries as ts_mod
+        from parameter_server_tpu.utils import trace as trace_mod
 
+        tr_dir = tmp_mod.mkdtemp(prefix="pstrace_bench_")
         obs_rounds = []
         for _ in range(5):
             flightrec.configure(None)
             flightrec.configure_spool(None)
             prof_mod.configure(0)
+            trace_mod.configure(None)
             off = _rps_pipelined(400)
             flightrec.configure(
                 bb_dir, process_name="bench-wire_rpc",
@@ -1354,6 +1360,10 @@ def child_wire_rpc() -> dict:
             )
             flightrec.configure_spool(4096)
             prof_mod.configure(prof_mod.DEFAULT_HZ)
+            trace_mod.configure(
+                tr_dir, process_name="bench-wire_rpc",
+                sample=16, tail=True,
+            )
             roller = ts_mod.Roller(0.1)
             try:
                 on = _rps_pipelined(400)
@@ -1362,6 +1372,7 @@ def child_wire_rpc() -> dict:
                 prof_mod.configure(0)
                 flightrec.configure(None)
                 flightrec.configure_spool(None)
+                trace_mod.configure(None)
             obs_rounds.append((off, on))
         out["push_rps_observability_off"] = round(
             stats.median(r[0] for r in obs_rounds), 1
@@ -1371,6 +1382,36 @@ def child_wire_rpc() -> dict:
         )
         out["observability_ratio"] = round(
             stats.median(on / off for off, on in obs_rounds), 3
+        )
+        # proof the tail-capture layer ENGAGED during the armed rounds
+        # (a ratio measured with promotion never firing proves nothing)
+        out["trace_tail_promoted"] = wire_counters.get(
+            "trace_tail_promoted"
+        )
+
+        # ISSUE 15's MARGINAL cost, isolated: tracing armed (sample=16)
+        # on BOTH sides, tail capture toggled — what the retention layer
+        # itself adds on top of the tracing plane. The full-stack
+        # observability_ratio above now includes armed tracing, whose
+        # own per-span cost (span + wire-context header) dominates on
+        # this pure-RPC loop; this ratio answers "does TAIL CAPTURE
+        # blow the budget" without conflating the two.
+        tail_rounds = []
+        for _ in range(5):
+            trace_mod.configure(
+                tr_dir, process_name="bench-wire_rpc", sample=16,
+                tail=False,
+            )
+            off = _rps_pipelined(400)
+            trace_mod.configure(
+                tr_dir, process_name="bench-wire_rpc", sample=16,
+                tail=True,
+            )
+            on = _rps_pipelined(400)
+            tail_rounds.append((off, on))
+        trace_mod.configure(None)
+        out["trace_tail_ratio"] = round(
+            stats.median(on / off for off, on in tail_rounds), 3
         )
         lockstep.close()
         pipelined.close()

@@ -210,6 +210,44 @@ def _build_parser() -> argparse.ArgumentParser:
         help="recent violations to include in the panel",
     )
 
+    wl = sub.add_parser(
+        "whylate",
+        help="tail-latency forensics (analysis/critpath.py): stitch "
+        "logical push/pull ops across processes and attribute their "
+        "wall time to named pipeline segments (client_queue, wire, "
+        "server, apply_wait, apply, reply_lane, ssp_wait). Feed it a "
+        "PS_TRACE_DIR capture (tail-capture sidecars rescued), a "
+        "PS_BLACKBOX_DIR postmortem, or a live cluster via "
+        "--scheduler; --baseline gates per-segment p99 budgets with "
+        "tiered exit codes (1 = hard regression, 2 = over budget)",
+    )
+    wl.add_argument(
+        "dir", nargs="?", default="",
+        help="trace or blackbox capture dir (omit with --scheduler)",
+    )
+    wl.add_argument(
+        "--scheduler", default="",
+        help="live mode: read the heartbeat-piggybacked slowest-op "
+        "records from this coordinator instead of a capture dir",
+    )
+    wl.add_argument(
+        "--top", type=int, default=5,
+        help="slowest ops to list per command",
+    )
+    wl.add_argument("--json", action="store_true")
+    wl.add_argument(
+        "--baseline", default="", metavar="FILE",
+        help="per-segment latency budgets (JSON: budgets_ms[cmd][seg] "
+        "+ hard_factor); exit 1 when a segment p99 exceeds "
+        "hard_factor x budget, 2 when it merely exceeds budget "
+        "(the pslint --baseline tiering)",
+    )
+    wl.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite --baseline from this capture's per-segment p99s "
+        "(x2 slack)",
+    )
+
     pm = sub.add_parser(
         "postmortem",
         help="merge the black-box dumps of a crashed/stalled cluster "
@@ -892,6 +930,79 @@ def run_audit(args: argparse.Namespace) -> int:
         ctl.close()
 
 
+def run_whylate(args: argparse.Namespace) -> int:
+    """Tail-latency forensics (``cli whylate``): critical-path
+    attribution over a trace/blackbox capture dir or a live cluster,
+    with optional per-segment budget gating (tiered exits: 0 within
+    budget, 2 over budget, 1 past the hard factor — the pslint
+    ``--baseline`` convention, so CI fails on WHICH segment
+    regressed)."""
+    from parameter_server_tpu.analysis import critpath
+
+    if bool(args.dir) == bool(args.scheduler):
+        raise SystemExit(
+            "whylate needs exactly one input: a capture dir or "
+            "--scheduler host:port"
+        )
+    if args.scheduler and (args.baseline or args.update_baseline):
+        # live records carry only the slowest-K segment splits, not the
+        # per-segment p99 population a budget gates on: silently passing
+        # every budget (or rewriting the committed baseline to empty)
+        # would be a CI gate that never fires
+        raise SystemExit(
+            "whylate --baseline/--update-baseline gate offline captures; "
+            "point them at a trace/blackbox dir, not --scheduler"
+        )
+    if args.update_baseline and not args.baseline:
+        raise SystemExit(
+            "whylate --update-baseline needs --baseline FILE (the file "
+            "to rewrite) — without it nothing would be written"
+        )
+    if args.scheduler:
+        from parameter_server_tpu.parallel.control import ControlClient
+
+        ctl = ControlClient(
+            args.scheduler, retries=5, reconnect_timeout_s=5.0
+        )
+        try:
+            summary = critpath.analyze_live(ctl.telemetry(), top=args.top)
+        finally:
+            ctl.close()
+    else:
+        summary = critpath.analyze_dir(args.dir, top=args.top)
+    findings: list[dict] = []
+    rc = 0
+    if args.baseline and args.update_baseline:
+        critpath.update_baseline(summary, args.baseline)
+    elif args.baseline:
+        if not summary.get("ops"):
+            # an empty capture cannot PASS a budget gate: zero stitched
+            # ops means the export (or the dir argument) broke, and
+            # exiting 0 here would silently disarm the CI contract
+            raise SystemExit(
+                f"whylate --baseline: no stitchable ops found in "
+                f"{args.dir!r} — cannot gate an empty capture"
+            )
+        findings = critpath.check_baseline(
+            summary, critpath.load_baseline(args.baseline)
+        )
+        rc = critpath.baseline_exit_code(findings)
+    if args.json:
+        print(json.dumps(
+            {**summary, "baseline_findings": findings}, default=float
+        ))
+        return rc
+    print(critpath.render_report(summary, top=args.top))
+    for f in findings:
+        print(
+            f"BUDGET {f['tier'].upper()}: {f['cmd']}.{f['segment']} "
+            f"p99 {f['p99_ms']}ms > budget {f['budget_ms']}ms"
+        )
+    if args.baseline and not args.update_baseline and not findings:
+        print("all segment budgets met")
+    return rc
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.cmd == "lint":
@@ -996,6 +1107,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "audit":
         # no config file: the sentinel reads the live coordinator
         return run_audit(args)
+    if args.cmd == "whylate":
+        # no config file: forensics read a capture dir or the live
+        # coordinator's piggybacked slow-op records
+        return run_whylate(args)
     if args.cmd == "postmortem":
         # no config file: a postmortem works from the dumps alone
         from parameter_server_tpu.utils.postmortem import postmortem
@@ -1019,6 +1134,10 @@ def main(argv: list[str] | None = None) -> int:
             trace.configure(
                 cfg.trace.trace_dir, capacity=cfg.trace.capacity,
                 process_name="train",
+                sample=cfg.trace.sample,
+                tail=cfg.trace.tail,
+                tail_k=cfg.trace.tail_k,
+                tail_limbo=cfg.trace.tail_limbo,
             )
         # live-ops arming for the single-process train path (spawned
         # node roles arm in run_node with role-rank names): continuous

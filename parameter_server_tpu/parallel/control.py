@@ -110,6 +110,7 @@ from parameter_server_tpu.utils.metrics import (
     merge_progress,
     merge_telemetry,
     race_track,
+    slow_ops,
     telemetry_snapshot,
     wire_counters,
 )
@@ -814,9 +815,13 @@ class RpcServer:
         hi_frames = lo_frames = 0
         # deferred replies (batched apply): settled before this thread
         # blocks on the socket, so an acked push is always applied;
-        # entries are (seq, deferred, cmd, t_svc, bin_hdr, advert, feats)
+        # entries are (seq, deferred, cmd, t_svc, bin_hdr, advert,
+        # feats, trace_ctx)
         deferred: list[
-            tuple[Any, DeferredReply, str, float, bool, bool, list | None]
+            tuple[
+                Any, DeferredReply, str, float, bool, bool,
+                list | None, dict | None,
+            ]
         ] = []
 
         def queue_reply(
@@ -860,13 +865,18 @@ class RpcServer:
 
         def decorated(
             rep: dict[str, Any], seq_d: Any, adv_d: bool,
-            feat_d: list | None = None,
+            feat_d: list | None = None, svc_us: int | None = None,
         ) -> dict[str, Any]:
             """One copy of the reply decoration: echo the request's seq
             (``_rseq``), ack the codec advert (``_bh``) and/or the
-            feature advert (``_feat``) on a COPY — ``rep`` may be a
-            shared reply-cache dict."""
-            if seq_d is None and not adv_d and feat_d is None:
+            feature advert (``_feat``), and stamp the server-observed
+            service time (``_svc_us`` — the client's latency-forensics
+            planes split wall time into wire vs server from this echo)
+            on a COPY — ``rep`` may be a shared reply-cache dict."""
+            if (
+                seq_d is None and not adv_d and feat_d is None
+                and svc_us is None
+            ):
                 return rep
             rep = dict(rep)
             if seq_d is not None:
@@ -875,6 +885,8 @@ class RpcServer:
                 rep["_bh"] = 1
             if feat_d is not None:
                 rep["_feat"] = feat_d
+            if svc_us is not None:
+                rep["_svc_us"] = svc_us
             return rep
 
         def settle_deferred() -> None:
@@ -885,7 +897,9 @@ class RpcServer:
             drain sees exactly the entries whose replies were never
             queued — none stranded, none double-counted."""
             while deferred:
-                seq_d, d, cmd_d, t_d, bin_d, adv_d, feat_d = deferred[0]
+                seq_d, d, cmd_d, t_d, bin_d, adv_d, feat_d, tctx_d = (
+                    deferred[0]
+                )
                 try:
                     rep_d, arrays_d = d.future.result()
                 except ConnectionError:
@@ -902,12 +916,17 @@ class RpcServer:
                 except Exception as e:  # noqa: BLE001 — surfaced remotely
                     rep_d, arrays_d = {"ok": False, "error": repr(e)}, {}
                 deferred.pop(0)
+                svc_d = time.perf_counter() - t_d
                 latency_histograms.observe(
-                    f"server.{cmd_d}", time.perf_counter() - t_d
+                    f"server.{cmd_d}", svc_d,
+                    exemplar=(tctx_d or {}).get("tid"),
                 )
                 queue_reply(
-                    decorated(rep_d, seq_d, adv_d, feat_d), arrays_d,
-                    hi=False, bin_hdr=bin_d,
+                    decorated(
+                        rep_d, seq_d, adv_d, feat_d,
+                        svc_us=int(svc_d * 1e6),
+                    ),
+                    arrays_d, hi=False, bin_hdr=bin_d,
                 )
         with self._counter_lock:
             self._conns.add(conn)
@@ -998,7 +1017,8 @@ class RpcServer:
                             self._dispatch(cid, seq, dup_header, arrays)
                     if not isinstance(rep, DeferredReply):
                         latency_histograms.observe(
-                            f"server.{cmd_name}", time.perf_counter() - t_svc
+                            f"server.{cmd_name}", time.perf_counter() - t_svc,
+                            exemplar=(tctx or {}).get("tid"),
                         )
                 except RpcServer.Shutdown:
                     try:
@@ -1030,16 +1050,23 @@ class RpcServer:
                     flush_replies()
                     return  # applied, but the reply is lost; conn closed below
                 if isinstance(rep, DeferredReply):
-                    deferred.append(
-                        (seq, rep, cmd_name, t_svc, was_bin, advert, feat_ack)
-                    )
+                    deferred.append((
+                        seq, rep, cmd_name, t_svc, was_bin, advert,
+                        feat_ack, tctx,
+                    ))
                     if len(deferred) >= 64:  # bound parked futures
                         settle_deferred()
                 else:
                     # the seq echo lets a pipelined client match this
                     # reply to the right in-flight future
                     queue_reply(
-                        decorated(rep, seq, advert, feat_ack), rep_arrays,
+                        decorated(
+                            rep, seq, advert, feat_ack,
+                            svc_us=int(
+                                (time.perf_counter() - t_svc) * 1e6
+                            ),
+                        ),
+                        rep_arrays,
                         hi=cmd_name in self._prio_cmds, bin_hdr=was_bin,
                     )
                 # flush when input drains — or at a lane bound: withheld
@@ -1394,7 +1421,19 @@ class RpcClient:
         # client-observed latency: queueing + wire + service + any
         # transparent retries/reconnects this call absorbed
         dt = time.perf_counter() - p.t0
-        latency_histograms.observe(f"client.{p.cmd}", dt)
+        tid = (p.header.get("_trace") or {}).get("tid")
+        latency_histograms.observe(f"client.{p.cmd}", dt, exemplar=tid)
+        # latency forensics (ISSUE 15): the reply's server-timing echo
+        # splits this call's wall time into wire vs server vs apply
+        # segments; the slowest-K records ride the heartbeat piggyback
+        # for `cli whylate --scheduler` / the `cli top` breakdown line
+        slow_ops.observe(
+            p.cmd, dt,
+            svc_us=rep.get("_svc_us"),
+            apw_us=rep.get("_apw_us"),
+            apl_us=rep.get("_apl_us"),
+            tid=tid,
+        )
         self._completed_n += 1  # GIL-atomic; feeds the stall probe
         flightrec.record(
             "rpc.reply", cmd=p.cmd, cid=self._cid, seq=p.seq,
@@ -1500,6 +1539,21 @@ class RpcClient:
         future fails with ConnectionError."""
         wire_counters.inc("rpc_retries")
         trace.instant("rpc.retry", cat="rpc", addr=self._address)
+        if trace.enabled():
+            # the heal usually runs on a reader/writer thread with no
+            # live span: mark the retry on EVERY stranded call's OWN
+            # trace (explicit ctx), so tail capture's anomaly gate
+            # promotes the traces that actually absorbed this reconnect
+            with self._cv:
+                tctxs = [
+                    p.header.get("_trace") for p in self._pending.values()
+                ]
+            for tctx in tctxs:
+                if tctx:
+                    trace.instant(
+                        "rpc.retry", cat="rpc", ctx=tctx,
+                        addr=self._address,
+                    )
         flightrec.record(
             "rpc.heal.begin", addr=self._address, cid=self._cid,
         )
@@ -1591,8 +1645,27 @@ class RpcClient:
                     return
                 continue
             with self._cv:
-                self._healing = False
-                self._cv.notify_all()
+                # the resend "succeeded" locally (bytes in the kernel
+                # buffer), but the replacement may ALREADY be dead: its
+                # reader, seeing EOF while _healing was still True,
+                # deferred to this heal (see _conn_died) and nulled the
+                # socket. Declaring victory then would strand the whole
+                # window — sent-claimed pending entries with no socket,
+                # no writer and no healer (a real livelock caught by the
+                # chaos drills under load). Only a still-installed
+                # socket ends the heal; otherwise retry in-window.
+                healed = self._sock is sock
+                if healed:
+                    self._healing = False
+                    self._cv.notify_all()
+            if not healed:
+                if time.monotonic() >= deadline:
+                    self._abort_heal(ConnectionError(
+                        f"server {self._address} kept resetting for "
+                        f"{self._reconnect_timeout_s}s"
+                    ))
+                    return
+                continue
             flightrec.record(
                 "rpc.healed", addr=self._address, cid=self._cid,
                 resent=len(pend),

@@ -175,7 +175,7 @@ class _QueuedPush:
     still joins the client's trace across the thread hop), and the Future
     the deferred RPC reply resolves from."""
 
-    __slots__ = ("keys", "grad", "cid", "seq", "tctx", "future")
+    __slots__ = ("keys", "grad", "cid", "seq", "tctx", "future", "t_enq")
 
     def __init__(
         self, keys: np.ndarray, grad: np.ndarray,
@@ -188,6 +188,10 @@ class _QueuedPush:
         self.seq = seq
         self.tctx = tctx
         self.future: Future = Future()
+        # enqueue mark: the apply thread reports queue-wait vs jitted-
+        # apply time back through the deferred reply (_apw_us/_apl_us),
+        # the latency-forensics planes' apply-segment split
+        self.t_enq = time.perf_counter()
 
 
 class ShardServer:
@@ -641,6 +645,7 @@ class ShardServer:
         todo: list[_QueuedPush] = []
         dups: list[_QueuedPush] = []
         commit_ver = 0
+        t_apply0 = t_apply1 = 0.0
         with self._lock:
             seen: set[tuple[str | None, str | None]] = set()
             for p in batch:
@@ -669,6 +674,7 @@ class ShardServer:
                     seen.add((p.cid, p.seq))
                 todo.append(p)
             if todo:
+                t_apply0 = time.perf_counter()
                 # pad_to_pow2: a coalesced union has a different length
                 # every batch, and each fresh shape re-dispatches the
                 # whole eager updater chain — the pow-2 bucket pins
@@ -701,6 +707,10 @@ class ShardServer:
                     # post-batch table, never a torn mix
                     self.state = new_state
                     commit_ver = self.version
+        t_apply1 = time.perf_counter()
+        #: jitted-apply duration for this batch (the latency-forensics
+        #: apply segment, echoed on replies and the updater markers)
+        apl_us = int(max(t_apply1 - t_apply0, 0.0) * 1e6) if todo else 0
         if todo:
             # the postmortem's AND the live auditor's acked-vs-applied
             # ledger: every (cid, seq) this commit made durable, against
@@ -729,21 +739,40 @@ class ShardServer:
         if trace.enabled():
             # per-push updater spans re-join each caller's trace across
             # the thread hop (the PR-2 contract: one logical push is one
-            # trace id, client span -> dispatch span -> updater span)
+            # trace id, client span -> dispatch span -> updater span).
+            # The marker fires AFTER the batch applied, so it carries
+            # the measured queue-wait/apply split as args — the
+            # critical-path engine reads them to split the post-dispatch
+            # gap into apply_wait vs apply (jit compiles land in the
+            # right column)
             for p in todo:
                 with trace.activate(p.tctx), trace.span(
                     "server.updater", cat="ps",
                     keys=len(p.keys), batched=len(todo),
+                    apw_us=int(max(t_apply0 - p.t_enq, 0.0) * 1e6),
+                    apl_us=apl_us,
                 ):
                     pass
         # dups resolve here too: the publish they waited on has happened
         # (on an exception above, neither list resolves — the apply loop's
         # per-item retry re-runs them, and a dup then replays off the
-        # ledger its first instance just wrote)
+        # ledger its first instance just wrote). The reply carries the
+        # apply-segment timings (_apw_us queue wait, _apl_us jitted
+        # apply) the RPC layer's _svc_us echo can't see from outside —
+        # the latency-forensics split of "server" into its real phases.
         for p in todo + dups:
             if not p.future.done():  # the shutdown race may fail one first
                 try:
-                    p.future.set_result(({"ok": True}, {}))
+                    p.future.set_result((
+                        {
+                            "ok": True,
+                            "_apw_us": int(
+                                max(t_apply0 - p.t_enq, 0.0) * 1e6
+                            ),
+                            "_apl_us": apl_us,
+                        },
+                        {},
+                    ))
                 except Exception:  # noqa: BLE001 — lost the race benignly
                     pass
 
@@ -2772,6 +2801,11 @@ def run_node(
             tdir, capacity=cfg.trace.capacity,
             process_name=f"{role}-{rank}",
             sample=sample,
+            # tail-biased capture (ISSUE 15): on by default — promotion
+            # rescues the slow traces head sampling would drop
+            tail=cfg.trace.tail,
+            tail_k=cfg.trace.tail_k,
+            tail_limbo=cfg.trace.tail_limbo,
         )
     # arm the black box: config [blackbox] dir wins, then the inherited
     # PS_BLACKBOX_DIR (launch_local's arming path) — re-configured even

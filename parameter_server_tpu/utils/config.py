@@ -386,6 +386,16 @@ class TraceConfig:
     # tracing at production step rates keeps whole traces, never
     # fragments. 1 (default) records everything.
     sample: int = 1
+    # tail-biased capture (ISSUE 15): head-dropped traces buffer until
+    # completion and PROMOTE past the sampler when they land in the
+    # slowest-K per cmd, carry anomaly events, or breach the live
+    # windowed p99 — so `sample = N` keeps exactly the traces a tail-
+    # latency investigation needs. On by default wherever tracing is
+    # armed (run_node / the train path); disable to get the pure
+    # head-sampled stream back.
+    tail: bool = True
+    tail_k: int = 4  # slowest-K retained per root-span name per window
+    tail_limbo: int = 8192  # limbo ring bound (events) for the sidecar
 
 
 @dataclass

@@ -120,17 +120,27 @@ class Histogram:
 
     Observations are seconds; buckets are powers of two of microseconds,
     so the whole distribution is ~40 ints — cheap to snapshot into a
-    heartbeat and exact to merge across nodes (bucket-wise sums)."""
+    heartbeat and exact to merge across nodes (bucket-wise sums).
 
-    __slots__ = ("_counts", "_count", "_sum", "_lock")
+    **Tail-trace exemplars** (ISSUE 15): an observation may carry an
+    exemplar id (the trace id of the RPC it measures); the histogram
+    retains the id of the max-latency observation of the current window
+    (rolled with the peak-gauge discipline — ``snapshot(roll_exemplar=
+    True)`` is the telemetry/heartbeat path, plain reads observe
+    without consuming). The exemplar rides snapshots as ``ex`` and the
+    OpenMetrics exposition as the standard exemplar syntax, linking a
+    p99 blowup on a dashboard to the retained trace that caused it."""
+
+    __slots__ = ("_counts", "_count", "_sum", "_ex", "_lock")
 
     def __init__(self) -> None:
         self._counts = [0] * _HIST_BUCKETS
         self._count = 0
         self._sum = 0.0
+        self._ex: tuple[float, str, float] | None = None  # (v_s, tid, ts)
         self._lock = threading.Lock()
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float, exemplar: str | None = None) -> None:
         i = int(seconds * 1e6).bit_length()
         if i >= _HIST_BUCKETS:
             i = _HIST_BUCKETS - 1
@@ -138,18 +148,33 @@ class Histogram:
             self._counts[i] += 1
             self._count += 1
             self._sum += seconds
+            if exemplar is not None and (
+                self._ex is None or seconds > self._ex[0]
+            ):
+                self._ex = (seconds, exemplar, time.time())
 
-    def snapshot(self) -> dict[str, Any]:
+    def snapshot(self, roll_exemplar: bool = False) -> dict[str, Any]:
         """Wire-friendly form: sparse ``{bucket_index: count}`` (JSON
-        string keys) plus count/sum — what heartbeats piggyback."""
+        string keys) plus count/sum — what heartbeats piggyback — and
+        the window's max-latency exemplar (``ex``) when one was
+        recorded. ``roll_exemplar=True`` resets the exemplar window
+        (the telemetry plane's roll; observe-only readers like the
+        blackbox flusher and ``/metrics`` scrapes must not consume)."""
         with self._lock:
-            return {
+            out: dict[str, Any] = {
                 "count": self._count,
                 "sum_s": self._sum,
                 "buckets": {
                     str(i): c for i, c in enumerate(self._counts) if c
                 },
             }
+            if self._ex is not None:
+                out["ex"] = {
+                    "v": self._ex[0], "tid": self._ex[1], "ts": self._ex[2],
+                }
+                if roll_exemplar:
+                    self._ex = None
+            return out
 
     def percentile(self, p: float) -> float:
         return hist_percentile(self.snapshot(), p)
@@ -172,13 +197,18 @@ def hist_percentile(snap: dict[str, Any], p: float) -> float:
 
 
 def merge_hist_snapshots(snaps: list[dict[str, Any]]) -> dict[str, Any]:
-    """Bucket-wise sum of Histogram snapshots (the cluster-wide merge)."""
+    """Bucket-wise sum of Histogram snapshots (the cluster-wide merge);
+    exemplars merge as the max-latency one — the cluster's worst
+    observation keeps its trace id through the merge."""
     out: dict[str, Any] = {"count": 0, "sum_s": 0.0, "buckets": {}}
     for s in snaps:
         out["count"] += s.get("count", 0)
         out["sum_s"] += s.get("sum_s", 0.0)
         for k, c in s.get("buckets", {}).items():
             out["buckets"][k] = out["buckets"].get(k, 0) + c
+        ex = s.get("ex")
+        if ex and ex.get("v", 0.0) > (out.get("ex") or {}).get("v", 0.0):
+            out["ex"] = dict(ex)
     return out
 
 
@@ -192,21 +222,28 @@ class HistogramSet:
         self._d: dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
-    def observe(self, name: str, seconds: float) -> None:
+    def observe(
+        self, name: str, seconds: float, exemplar: str | None = None
+    ) -> None:
         h = self._d.get(name)
         if h is None:
             with self._lock:
                 h = self._d.setdefault(name, Histogram())
-        h.observe(seconds)
+        h.observe(seconds, exemplar=exemplar)
 
     def get(self, name: str) -> Histogram | None:
         with self._lock:
             return self._d.get(name)
 
-    def snapshot(self) -> dict[str, dict[str, Any]]:
+    def snapshot(
+        self, roll_exemplars: bool = False
+    ) -> dict[str, dict[str, Any]]:
         with self._lock:
             hists = dict(self._d)
-        return {k: h.snapshot() for k, h in hists.items()}
+        return {
+            k: h.snapshot(roll_exemplar=roll_exemplars)
+            for k, h in hists.items()
+        }
 
     def reset(self) -> None:
         """Tests/benchmarks only (see CounterSet.reset)."""
@@ -216,6 +253,114 @@ class HistogramSet:
 
 #: process-global per-command RPC latency histograms
 latency_histograms = HistogramSet()
+
+
+class SlowOps:
+    """Bounded slowest-K RPCs per command with a per-call segment split
+    (ISSUE 15's live leg of latency forensics).
+
+    Fed by the RPC client's completion path: every reply now echoes the
+    server's service time (``_svc_us``; batched pushes add apply-queue
+    wait ``_apw_us`` and jitted-apply ``_apl_us``), so the client can
+    split its observed wall time into **wire** (client-observed minus
+    server-observed — queueing on the socket, the network, server recv
+    buffering, any reply-lane withholding) vs **server** (dispatch)
+    vs **apply_wait** / **apply**, with no span shipping. Records carry
+    the trace id when tracing is armed, linking a live slow op to its
+    retained tail trace. Entries expire after ``window_s`` so the view
+    tracks *now*; the whole structure rides the heartbeat piggyback
+    (``telemetry_snapshot()["slow"]``) the way hot stacks do."""
+
+    def __init__(self, k: int = 8, window_s: float = 60.0):
+        self.k = max(1, int(k))
+        self.window_s = float(window_s)
+        self._d: dict[str, list[dict[str, Any]]] = {}
+        self._lock = threading.Lock()
+
+    def observe(
+        self,
+        cmd: str,
+        total_s: float,
+        svc_us: float | None = None,
+        apw_us: float | None = None,
+        apl_us: float | None = None,
+        tid: str | None = None,
+    ) -> None:
+        now = time.time()
+        with self._lock:
+            recs = self._d.get(cmd)
+            if recs is None:
+                recs = self._d[cmd] = []
+            lo = now - self.window_s
+            if recs:
+                # prune unconditionally: recs is DURATION-sorted, so no
+                # single position's timestamp proves the rest are live —
+                # stale giants must not hold slots, evict live records
+                # or fast-reject new ones against a dead floor (k <= 8,
+                # the scan is trivial)
+                recs[:] = [r for r in recs if r["ts"] >= lo]
+            if len(recs) >= self.k and total_s * 1e3 <= recs[-1]["dur_ms"]:
+                return  # fast reject: not in the window's slowest-K
+            rec: dict[str, Any] = {
+                "cmd": cmd,
+                "dur_ms": round(total_s * 1e3, 3),
+                "ts": now,
+            }
+            if tid is not None:
+                rec["tid"] = tid
+            if svc_us is not None:
+                svc_ms = float(svc_us) / 1e3
+                apw_ms = float(apw_us or 0) / 1e3
+                apl_ms = float(apl_us or 0) / 1e3
+                seg = {
+                    "wire": round(max(total_s * 1e3 - svc_ms, 0.0), 3),
+                    "server": round(max(svc_ms - apw_ms - apl_ms, 0.0), 3),
+                }
+                if apw_us is not None:
+                    seg["apply_wait"] = round(apw_ms, 3)
+                if apl_us is not None:
+                    seg["apply"] = round(apl_ms, 3)
+                rec["seg"] = seg
+            recs.append(rec)
+            recs.sort(key=lambda r: -r["dur_ms"])
+            del recs[self.k:]
+
+    def snapshot(self) -> dict[str, list[dict[str, Any]]]:
+        """Per-cmd slowest-K records (duration-descending), window-
+        expired; {} when nothing slow was seen."""
+        now = time.time()
+        lo = now - self.window_s
+        with self._lock:
+            out = {}
+            for cmd, recs in self._d.items():
+                live = [dict(r) for r in recs if r["ts"] >= lo]
+                if live:
+                    out[cmd] = live
+            return out
+
+    def reset(self) -> None:
+        """Tests/benchmarks only (see CounterSet.reset)."""
+        with self._lock:
+            self._d.clear()
+
+
+#: process-global slowest-RPC records (fed by RpcClient completions)
+slow_ops = SlowOps()
+
+
+def merge_slow_ops(
+    blocks: list[dict[str, list[dict[str, Any]]]], k: int = 8
+) -> dict[str, list[dict[str, Any]]]:
+    """Cluster merge of SlowOps snapshots: per-cmd concatenation,
+    duration-descending, trimmed to the slowest ``k``."""
+    out: dict[str, list[dict[str, Any]]] = {}
+    for b in blocks:
+        for cmd, recs in (b or {}).items():
+            out.setdefault(cmd, []).extend(recs)
+    for cmd, recs in out.items():
+        recs.sort(key=lambda r: -r.get("dur_ms", 0.0))
+        del recs[k:]
+    return out
 
 
 def observe_scalar(name: str, value: float) -> None:
@@ -510,7 +655,9 @@ def telemetry_snapshot(roll_peaks: bool = True) -> dict[str, Any]:
     and ``cli stats`` would always see ~0 peaks on an armed node)."""
     out = {
         "counters": wire_counters.snapshot(roll_peaks=roll_peaks),
-        "hists": latency_histograms.snapshot(),
+        # exemplars roll with the peak windows: the telemetry plane
+        # consumes each window's max-latency trace id exactly once
+        "hists": latency_histograms.snapshot(roll_exemplars=roll_peaks),
         "timers": timers.snapshot(),
     }
     heat = key_heat.snapshot()
@@ -519,6 +666,9 @@ def telemetry_snapshot(roll_peaks: bool = True) -> dict[str, Any]:
     prof = _profiler_top()
     if prof:
         out["prof"] = prof
+    slow = slow_ops.snapshot()
+    if slow:
+        out["slow"] = slow
     return out
 
 
@@ -532,6 +682,7 @@ def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
     tmr: dict[str, dict[str, float]] = {}
     heat: list[dict[str, Any]] = []
     prof: dict[str, int] = {}
+    slow: list[dict[str, Any]] = []
     for s in snaps:
         for k, v in s.get("counters", {}).items():
             if k.endswith("_peak"):
@@ -546,6 +697,8 @@ def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
             t["count"] += v.get("count", 0)
         if s.get("key_heat"):
             heat.append(s["key_heat"])
+        if s.get("slow"):
+            slow.append(s["slow"])
         for p in s.get("prof") or ():
             stack = str(p.get("s", ""))
             prof[stack] = prof.get(stack, 0) + int(p.get("n", 0))
@@ -556,6 +709,8 @@ def merge_telemetry(snaps: list[dict[str, Any]]) -> dict[str, Any]:
     }
     if heat:
         out["key_heat"] = merge_heat_snapshots(heat)
+    if slow:
+        out["slow"] = merge_slow_ops(slow)
     if prof:
         # cluster-wide hot stacks: sum per folded stack, keep a bounded
         # heaviest-first list (each node's block is already top-N)

@@ -69,6 +69,7 @@ _CONTEXT_EVENTS = frozenset({
     "step.dispatch",     # trainer step anatomy
     "step.retire",
     "thread.exception",  # threading.excepthook crash hook fired
+    "trace.promote",     # tail capture promoted a head-dropped trace
     "ts.roll",           # local time-series ring rolled a delta
     "watchdog.stall",    # stall firing (the dump's stalls list is the
                          # detector's source; the event is context)

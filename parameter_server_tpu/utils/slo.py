@@ -349,6 +349,31 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
             )
     elif audit:
         lines.append("audit: no protocol violations")
+    # the latency-forensics line (ISSUE 15): the cluster's slowest push
+    # of the window with its segment split (wire vs server vs apply,
+    # from the reply's server-timing echo) and the tail-trace id that
+    # links it to the retained trace — `cli whylate` is the deep dive
+    slow = (rep.get("merged") or {}).get("slow") or {}
+    worst = None
+    for cmd in ("push", "pull"):
+        recs = slow.get(cmd) or []
+        if recs and (worst is None or recs[0].get(
+            "dur_ms", 0.0
+        ) > worst.get("dur_ms", 0.0)):
+            worst = recs[0]
+    if worst:
+        seg = worst.get("seg") or {}
+        parts = "  ".join(
+            f"{k}={v}ms"
+            for k, v in sorted(seg.items(), key=lambda kv: -kv[1])
+        )
+        lines.append("")
+        lines.append(
+            f"slowest {worst.get('cmd', '?')}: "
+            f"{worst.get('dur_ms', 0.0)}ms"
+            + (f"  {parts}" if parts else "")
+            + (f"  tid={worst['tid']}" if worst.get("tid") else "")
+        )
     heat = (rep.get("merged") or {}).get("key_heat")
     if heat:
         pairs = heat_top(heat, 5)

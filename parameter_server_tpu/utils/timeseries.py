@@ -49,11 +49,15 @@ from typing import Any, Callable
 
 from parameter_server_tpu.utils import flightrec
 from parameter_server_tpu.utils.metrics import (
+    _HIST_BUCKETS,
     hist_percentile,
     merge_hist_snapshots,
     telemetry_snapshot,
     wire_counters,
 )
+
+#: clip bucket for exemplar placement (metrics.Histogram's top bucket)
+_HIST_TOP_BUCKET = _HIST_BUCKETS - 1
 
 METRICS_PORT_ENV = "PS_METRICS_PORT"
 
@@ -108,11 +112,16 @@ def _hist_delta(
         d = v - pb.get(k, 0)
         if d > 0:
             buckets[k] = d
-    return {
+    out = {
         "count": c,
         "sum_s": max(cur.get("sum_s", 0.0) - prev.get("sum_s", 0.0), 0.0),
         "buckets": buckets,
     }
+    if "ex" in cur:
+        # the exemplar is already windowed upstream (rolled per
+        # telemetry snapshot): ride the delta as-is
+        out["ex"] = cur["ex"]
+    return out
 
 
 def series_scale(name: str) -> float:
@@ -439,17 +448,37 @@ def render_openmetrics(
         m = _metric_name(name if count_valued else name + "_seconds")
         lines.append(f"# TYPE {m} histogram")
         buckets = {int(k): int(v) for k, v in s.get("buckets", {}).items()}
+        # tail-trace exemplar (ISSUE 15): the window's max-latency
+        # observation carries its trace id — rendered with the
+        # OpenMetrics exemplar syntax on the bucket containing it, so a
+        # dashboard's p99 spike links straight to the retained trace
+        ex = s.get("ex") or {}
+        ex_sfx = ""
+        ex_bucket = -1
+        if ex.get("tid") and not count_valued:
+            v = float(ex.get("v", 0.0))
+            ex_bucket = min(int(v * 1e6).bit_length(), _HIST_TOP_BUCKET)
+            ex_ts = ex.get("ts")
+            ex_sfx = (
+                f' # {{trace_id="{ex["tid"]}"}} {_fmt(v)}'
+                + (f" {_fmt(float(ex_ts))}" if ex_ts else "")
+            )
         cum = 0
         for i in sorted(buckets):
             cum += buckets[i]
             edge = float(1 << i) if count_valued else (1 << i) / 1e6
             le = f'le="{_fmt(edge)}"'
             lab = f'{{proc="{proc}",{le}}}' if proc else f"{{{le}}}"
-            lines.append(f"{m}_bucket{lab} {cum}")
+            sfx = ex_sfx if i == ex_bucket else ""
+            if sfx:
+                ex_sfx = ""  # attach exactly once
+            lines.append(f"{m}_bucket{lab} {cum}{sfx}")
         inf_lab = (
             f'{{proc="{proc}",le="+Inf"}}' if proc else '{le="+Inf"}'
         )
-        lines.append(f"{m}_bucket{inf_lab} {s.get('count', 0)}")
+        # an exemplar whose bucket is absent (merged/rolled snapshots)
+        # attaches to +Inf — an exemplar must never be silently lost
+        lines.append(f"{m}_bucket{inf_lab} {s.get('count', 0)}{ex_sfx}")
         total = s.get("sum_s", 0.0)
         if count_valued:
             total *= 1e6  # decode the as-if-microseconds value encoding

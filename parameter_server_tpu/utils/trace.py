@@ -31,6 +31,24 @@ explicitly (CLI ``--trace_dir`` / config ``[trace] trace_dir``). Each
 armed process writes ``trace-<name>-<pid>.json`` into the directory at
 exit (atexit backstop) or on ``tracer.flush()``.
 
+**Tail-biased capture** (:class:`TailCapture`, ISSUE 15): head sampling
+(``sample=1/N``) keeps 1/N of traces by trace-id hash — which
+statistically drops exactly the slow traces worth keeping. With tail
+capture armed, a head-DROPPED trace's spans are buffered per trace
+until the trace completes, and the completed trace is **promoted** to
+the export ring when it (a) lands in the slowest-K per root-span name
+for the current window, (b) carries anomaly events (rpc.retry /
+rpc.reconnect / an errored span), or (c) breaches the live windowed p99
+of its root name (the PR-2 log2 histogram machinery). Promotion
+overrides the head-sampling drop decision; unpromoted traces fall into
+a bounded limbo ring exported as a ``tracetail-*.json`` sidecar, so a
+trace another process promoted (the slow half of a cross-process push)
+can be rescued at merge/analysis time (``merge_trace_dir`` pulls
+sidecar events whose trace id appears in any main file). Memory is
+bounded everywhere (pending-trace count, events per trace, limbo ring);
+with tracing off the whole layer is the same identity-pinned no-op
+path as ever.
+
 API sketch::
 
     from parameter_server_tpu.utils import trace
@@ -52,17 +70,28 @@ import atexit
 import functools
 import json
 import os
+import random
 import threading
 import time
-import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Callable
 
 TRACE_DIR_ENV = "PS_TRACE_DIR"
 TRACE_SAMPLE_ENV = "PS_TRACE_SAMPLE"
+TRACE_TAIL_ENV = "PS_TRACE_TAIL"
 
 #: ring-buffer default: ~64k spans x ~200 B/event ~= 13 MB ceiling per process
 DEFAULT_CAPACITY = 65536
+
+#: tail-capture defaults (see TailCapture): slowest-K per root name kept
+#: per window, limbo sidecar ring bound, pending-trace bounds
+DEFAULT_TAIL_K = 4
+DEFAULT_TAIL_LIMBO = 8192
+
+#: instant-event names whose presence promotes the enclosing trace (the
+#: "anomaly-bearing" leg of the tail-promotion policy); errored spans
+#: (an ``error`` arg) promote through the same gate
+TAIL_ANOMALY_EVENTS = frozenset({"rpc.retry", "rpc.reconnect"})
 
 
 def _env_sample() -> int:
@@ -70,6 +99,19 @@ def _env_sample() -> int:
         return max(1, int(os.environ.get(TRACE_SAMPLE_ENV, "1") or 1))
     except ValueError:
         return 1
+
+
+def _env_tail_k() -> int:
+    """PS_TRACE_TAIL: the slowest-K bound for env-armed processes
+    (spawned children). Unset/empty/"1" = the default K armed; "0"
+    disarms tail capture; any other int = that K."""
+    raw = os.environ.get(TRACE_TAIL_ENV, "")
+    if raw in ("", "1"):
+        return DEFAULT_TAIL_K
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return DEFAULT_TAIL_K
 
 _current = threading.local()  # .span: innermost live span (or remote parent)
 
@@ -80,8 +122,39 @@ def _now_us() -> float:
     return time.time() * 1e6
 
 
+#: id generator: urandom-seeded Mersenne stream, NOT uuid4 — uuid4 hits
+#: posix.urandom per call (~12 us), which at two ids per span was the
+#: single largest cost of armed tracing on the push hot path. One C
+#: getrandbits call under the GIL is atomic enough for id draws.
+_id_rng = random.Random()
+
+
 def _new_id() -> str:
-    return uuid.uuid4().hex[:16]
+    return f"{_id_rng.getrandbits(64):016x}"
+
+
+#: cached OS identities for the per-event stamps: on sandboxed/para-
+#: virtualized kernels getpid/gettid are full-priced syscalls (~15 us
+#: here), and every recorded event stamps both. The pid refreshes on
+#: fork; the native thread id is cached per thread in the existing
+#: thread-local.
+_pid = os.getpid()
+
+
+def _refresh_pid() -> None:  # pragma: no cover - fork path
+    global _pid
+    _pid = os.getpid()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_refresh_pid)
+
+
+def _tid() -> int:
+    t = getattr(_current, "tid", None)
+    if t is None:
+        t = _current.tid = threading.get_native_id()
+    return t
 
 
 class _NoopSpan:
@@ -140,7 +213,7 @@ class Span:
 
     __slots__ = (
         "_tracer", "name", "cat", "trace_id", "span_id", "parent_id",
-        "args", "_t0_us", "_t0", "_prev",
+        "args", "_t0_us", "_t0", "_prev", "_tail_seal",
     )
 
     def __init__(
@@ -154,6 +227,11 @@ class Span:
         self.span_id = _new_id()
         self.parent_id = parent_id
         self.args = args
+        # set by Tracer.span for the LOCAL ROOT span of a head-dropped
+        # trace under tail capture: its exit seals the trace (promotion
+        # decision) — flag-driven, so a single-span trace (the RPC hot
+        # path's common case) never touches the pending table at all
+        self._tail_seal = False
 
     def set(self, **args: Any) -> None:
         """Attach/override args after entry (e.g. reply byte counts)."""
@@ -179,16 +257,19 @@ class Span:
             **({"parent_id": self.parent_id} if self.parent_id else {}),
             **self.args,
         }
-        self._tracer._record({
-            "name": self.name,
-            "cat": self.cat or "default",
-            "ph": "X",
-            "ts": self._t0_us,
-            "dur": dur_us,
-            "pid": os.getpid(),
-            "tid": threading.get_native_id(),
-            "args": args,
-        })
+        self._tracer._record(
+            {
+                "name": self.name,
+                "cat": self.cat or "default",
+                "ph": "X",
+                "ts": self._t0_us,
+                "dur": dur_us,
+                "pid": _pid,
+                "tid": _tid(),
+                "args": args,
+            },
+            tail_seal=self._tail_seal,
+        )
         return False
 
 
@@ -219,6 +300,278 @@ class _Activation:
         return False
 
 
+class _PendingTrace:
+    """One head-dropped trace buffered until completion (tail capture).
+    Created LAZILY by the first non-root event — a single-span trace
+    (the RPC hot path's common case) seals straight from its root exit
+    and never allocates one."""
+
+    __slots__ = ("events", "anomaly", "truncated")
+
+    def __init__(self) -> None:
+        self.events: list[dict[str, Any]] = []
+        self.anomaly = False
+        self.truncated = 0
+
+
+class TailCapture:
+    """The tail-retention layer (ISSUE 15): completion-time promotion of
+    head-dropped traces.
+
+    Head sampling decides keep/drop at trace START, so the slowest
+    traces — the ones worth keeping — die before anyone knows they are
+    slow. With this layer armed, a dropped trace's events buffer in a
+    per-trace pending list; when its (locally) root span exits, the
+    whole trace is judged at once:
+
+    - **slowest-K**: the root duration ranks in the top ``k`` for its
+      root-span name within the current window;
+    - **anomaly-bearing**: the trace carries a
+      :data:`TAIL_ANOMALY_EVENTS` instant or an errored span;
+    - **p99 breach**: the root duration exceeds the live windowed p99
+      of its name (per-name PR-2 log2 histograms, windowed by snapshot
+      deltas — the same discipline the time-series plane uses).
+
+    Promoted traces move into the tracer's export ring (overriding the
+    head-sampling drop) and fire a ``trace.promote`` flight-recorder
+    event; unpromoted ones land in a bounded **limbo** ring exported as
+    a ``tracetail-*.json`` sidecar so a cross-process trace promoted by
+    ANOTHER process (the client saw the tail latency; this server's
+    segment looked fast locally) is rescued at merge/analysis time.
+
+    Every structure is bounded: at most ``max_pending`` open traces
+    (the oldest is sealed unpromoted on overflow), ``max_events`` per
+    trace (extra events are counted, not kept), ``limbo_events`` limbo
+    entries, and K + one ~40-int histogram per distinct root name."""
+
+    _RECENT = 512  # sealed-verdict memory: late events still route right
+
+    def __init__(
+        self,
+        k: int = DEFAULT_TAIL_K,
+        limbo_events: int = DEFAULT_TAIL_LIMBO,
+        max_pending: int = 256,
+        max_events: int = 256,
+        window_s: float = 30.0,
+        min_window_count: int = 32,
+    ):
+        self.k = max(0, int(k))
+        self.window_s = float(window_s)
+        self.min_window_count = int(min_window_count)
+        self.max_pending = max(1, int(max_pending))
+        self.max_events = max(8, int(max_events))
+        self._pending: "OrderedDict[str, _PendingTrace]" = OrderedDict()
+        self._recent: "OrderedDict[str, bool]" = OrderedDict()
+        self._limbo: deque[dict[str, Any]] = deque(
+            maxlen=max(int(limbo_events), 64)
+        )
+        # per-root-name windowed stats: top-K durations + a log2
+        # histogram (utils/metrics.py machinery) with a baseline
+        # snapshot stashed at each window roll, so the p99 read is the
+        # DELTA percentile — the live windowed p99, not since-boot
+        self._top: dict[str, list[float]] = {}
+        self._hists: dict[str, Any] = {}
+        self._base: dict[str, dict[str, Any]] = {}
+        # per-name p99 read cache: the delta-percentile read (snapshot
+        # + bucket walk) is the seal path's priciest step; at hot-path
+        # seal rates it is refreshed at most every _P99_TTL_S per name
+        # (a slightly stale threshold only shifts WHICH borderline
+        # trace promotes — the slowest-K gate is exact regardless)
+        self._p99_cache: dict[str, tuple[float, float | None]] = {}
+        self._window_start = time.monotonic()
+        self._lock = threading.Lock()
+
+    _P99_TTL_S = 0.25
+
+    # -- stats -------------------------------------------------------------
+
+    def _roll_window_locked(self) -> None:
+        now = time.monotonic()
+        if now - self._window_start < self.window_s:
+            return
+        self._window_start = now
+        self._top.clear()
+        self._p99_cache.clear()
+        self._base = {k: h.snapshot() for k, h in self._hists.items()}
+
+    def _windowed_p99_locked(self, name: str) -> float | None:
+        from parameter_server_tpu.utils.metrics import hist_percentile
+
+        h = self._hists.get(name)
+        if h is None:
+            return None
+        snap = h.snapshot()
+        base = self._base.get(name)
+        if base:
+            snap = {
+                "count": snap["count"] - base.get("count", 0),
+                "buckets": {
+                    k: c - base.get("buckets", {}).get(k, 0)
+                    for k, c in snap.get("buckets", {}).items()
+                },
+            }
+        if snap.get("count", 0) < self.min_window_count:
+            return None
+        return hist_percentile(snap, 0.99)
+
+    def _p99_cached_locked(self, name: str) -> float | None:
+        now = time.monotonic()
+        hit = self._p99_cache.get(name)
+        if hit is not None and hit[0] > now:
+            return hit[1]
+        p99 = self._windowed_p99_locked(name)
+        self._p99_cache[name] = (now + self._P99_TTL_S, p99)
+        return p99
+
+    def observe_root(self, name: str, dur_s: float) -> None:
+        """Feed one completed root span into the windowed stats (kept
+        and dropped traces alike — the promotion thresholds must see
+        the whole population, not just the sampled-out slice)."""
+        with self._lock:
+            self._observe_root_locked(name, dur_s)
+
+    def _observe_root_locked(self, name: str, dur_s: float) -> None:
+        from parameter_server_tpu.utils.metrics import Histogram
+
+        self._roll_window_locked()
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = Histogram()
+        top = self._top.setdefault(name, [])
+        top.append(dur_s)
+        top.sort(reverse=True)
+        del top[self.k:]
+        h.observe(dur_s)  # Histogram's own lock is a leaf under ours
+
+    # -- pending-trace lifecycle ------------------------------------------
+
+    def _remember_locked(self, trace_id: str, promoted: bool) -> None:
+        self._recent[trace_id] = promoted
+        while len(self._recent) > self._RECENT:
+            self._recent.popitem(last=False)
+
+    def _open_locked(self, trace_id: str) -> _PendingTrace:
+        while len(self._pending) >= self.max_pending:
+            # overflow: the oldest pending trace seals unpromoted (its
+            # root span leaked or is very long-lived)
+            _t, old = self._pending.popitem(last=False)
+            self._limbo.extend(old.events)
+            self._remember_locked(_t, False)
+        pend = self._pending[trace_id] = _PendingTrace()
+        return pend
+
+    def route(self, trace_id: str, ev: dict[str, Any], tracer: "Tracer") -> bool:
+        """Destination decision for one recorded NON-sealing event of
+        ``trace_id``; True = consumed here (pending buffer or limbo),
+        False = the caller records it into the main ring. Root-span
+        exits of KEPT traces pass through but feed the windowed stats;
+        a head-dropped trace's first buffered event creates its pending
+        entry lazily (local-root exits go through :meth:`seal_event`
+        instead — flag-driven by the span layer).
+
+        Everything runs under ONE lock acquisition: events for one
+        trace arrive from several threads (the serve thread's dispatch
+        exit vs the apply thread's updater marker), and a buffer append
+        racing the seal would strand the event in an already-flushed
+        list, silently losing it from both ring and sidecar."""
+        args = ev.get("args") or {}
+        with self._lock:
+            pend = self._pending.get(trace_id)
+            if pend is None:
+                verdict = self._recent.get(trace_id)
+                if verdict is not None:
+                    if verdict:
+                        return False  # promoted: late events join the ring
+                    self._limbo.append(ev)
+                    return True
+                if tracer._keep(trace_id):
+                    # a head-KEPT trace — record normally, observing
+                    # parentless root completions into the stats
+                    if ev.get("ph") == "X" and "parent_id" not in args:
+                        self._observe_root_locked(
+                            ev["name"], ev.get("dur", 0.0) / 1e6
+                        )
+                    return False
+                pend = self._open_locked(trace_id)
+            if (
+                ev.get("ph") == "i" and ev["name"] in TAIL_ANOMALY_EVENTS
+            ) or "error" in args:
+                pend.anomaly = True
+            if len(pend.events) >= self.max_events:
+                pend.truncated += 1
+            else:
+                pend.events.append(ev)
+            return True
+
+    def seal_event(
+        self, trace_id: str, root_ev: dict[str, Any], tracer: "Tracer"
+    ) -> bool:
+        """A head-dropped trace's LOCAL ROOT span exited (the span layer
+        flags it): judge the whole trace — buffered children plus this
+        root event, which ALWAYS keeps its slot (a promoted trace
+        exported without its root would be unstitchable by the
+        critical-path engine). True = consumed (promoted to the ring as
+        a batch, or limbo'd); False = late root of an already-promoted
+        trace, caller records it into the ring."""
+        args = root_ev.get("args") or {}
+        name = root_ev["name"]
+        dur_s = root_ev.get("dur", 0.0) / 1e6
+        why = None
+        promoted_events: list[dict[str, Any]] | None = None
+        with self._lock:
+            verdict = self._recent.get(trace_id)
+            if verdict is not None:
+                # a second local root (e.g. the apply thread's updater
+                # marker after the dispatch span sealed): late event
+                if verdict:
+                    return False
+                self._limbo.append(root_ev)
+                return True
+            pend = self._pending.pop(trace_id, None)
+            events = pend.events if pend is not None else []
+            events.append(root_ev)
+            anomaly = (
+                pend.anomaly if pend is not None else False
+            ) or "error" in args
+            self._roll_window_locked()
+            if anomaly:
+                why = "anomaly"
+            else:
+                top = self._top.get(name) or []
+                if self.k > 0 and (len(top) < self.k or dur_s > top[-1]):
+                    why = "slowk"
+                else:
+                    p99 = self._p99_cached_locked(name)
+                    if p99 is not None and dur_s > p99:
+                        why = "p99"
+            self._observe_root_locked(name, dur_s)
+            self._remember_locked(trace_id, why is not None)
+            if why is None:
+                self._limbo.extend(events)
+            else:
+                promoted_events = events
+        # counters / ring append / flightrec OUTSIDE the tail lock
+        from parameter_server_tpu.utils.metrics import wire_counters
+
+        if promoted_events is None:
+            wire_counters.inc("trace_tail_dropped")
+            return True
+        tracer._append_events(promoted_events)
+        wire_counters.inc("trace_tail_promoted")
+        from parameter_server_tpu.utils import flightrec
+
+        flightrec.record(
+            "trace.promote", cmd=name, tid=trace_id, why=why,
+            dur_ms=round(dur_s * 1e3, 3),
+        )
+        return True
+
+    def limbo_events(self) -> list[dict[str, Any]]:
+        """Snapshot of the unpromoted-trace ring (the sidecar's body)."""
+        with self._lock:
+            return list(self._limbo)
+
+
 class Tracer:
     """Span recorder with a Chrome trace-event exporter. One module-global
     instance (``trace.tracer``) serves the process; the module-level
@@ -231,6 +584,7 @@ class Tracer:
         capacity: int = DEFAULT_CAPACITY,
         process_name: str = "",
         sample: int = 1,
+        tail: TailCapture | None = None,
     ):
         self._dir = trace_dir or None
         self._buf: deque[dict[str, Any]] = deque(maxlen=max(capacity, 1))
@@ -241,6 +595,9 @@ class Tracer:
         # same traces, so always-on tracing at production step rates
         # yields whole cross-process traces, never fragments
         self._sample = max(1, int(sample))
+        # tail-biased retention (ISSUE 15): with this armed, the head
+        # sampler's drop verdict becomes provisional — see TailCapture
+        self._tail = tail if self._dir is not None else None
 
     @property
     def enabled(self) -> bool:
@@ -264,13 +621,21 @@ class Tracer:
     def trace_dir(self) -> str | None:
         return self._dir
 
+    @property
+    def tail(self) -> TailCapture | None:
+        """The armed tail-capture layer (None when off)."""
+        return self._tail
+
     # -- recording --------------------------------------------------------
 
     def span(self, name: str, cat: str = "", **args: Any):
         """Context manager for one span. Disabled path: returns the
         process-global no-op singleton (no allocation). A trace the head
         sampler drops gets a :class:`_DroppedSpan` instead — nesting and
-        propagation intact, nothing recorded."""
+        propagation intact, nothing recorded — UNLESS tail capture is
+        armed, in which case the span records into the trace's pending
+        buffer and the keep/drop verdict waits for trace completion
+        (TailCapture: promotion overrides the head drop)."""
         if self._dir is None:
             return _NOOP
         cur = getattr(_current, "span", None)
@@ -279,18 +644,38 @@ class Tracer:
         else:
             trace_id, parent = _new_id(), None
         if not self._keep(trace_id):
-            return _DroppedSpan(trace_id)
+            tail = self._tail
+            if tail is None:
+                return _DroppedSpan(trace_id)
+            sp = Span(self, name, cat, trace_id, parent, args)
+            # the LOCAL root (trace started here, or entered via a
+            # remote activation) seals the trace at exit; nested local
+            # spans just buffer
+            sp._tail_seal = cur is None or isinstance(cur, _RemoteParent)
+            return sp
         return Span(self, name, cat, trace_id, parent, args)
 
-    def instant(self, name: str, cat: str = "", **args: Any) -> None:
+    def instant(
+        self, name: str, cat: str = "",
+        ctx: dict[str, str] | None = None, **args: Any,
+    ) -> None:
         """Point-in-time annotation (retry fired, reconnect started);
-        rides the current span's trace when one is live."""
+        rides the current span's trace when one is live. ``ctx`` binds
+        an EXPLICIT wire context instead — for emitters on threads with
+        no live span acting on another trace's behalf (the heal marks
+        every stranded pending call's trace, so the tail-capture
+        anomaly gate sees the reconnect the trace actually absorbed)."""
         if self._dir is None:
             return
-        cur = getattr(_current, "span", None)
-        if cur is not None and cur.trace_id is not None:
-            if not self._keep(cur.trace_id):
-                return  # the instant belongs to a head-dropped trace
+        if ctx:
+            if not self._keep(ctx["tid"]) and self._tail is None:
+                return  # head-dropped trace, no tail layer to buffer it
+            args = {"trace_id": ctx["tid"], "parent_id": ctx["sid"], **args}
+        elif (cur := getattr(_current, "span", None)) is not None and (
+            cur.trace_id is not None
+        ):
+            if not self._keep(cur.trace_id) and self._tail is None:
+                return  # head-dropped trace, no tail layer to buffer it
             args = {"trace_id": cur.trace_id, "parent_id": cur.span_id, **args}
         self._record({
             "name": name,
@@ -298,8 +683,8 @@ class Tracer:
             "ph": "i",
             "ts": _now_us(),
             "s": "t",  # thread-scoped instant
-            "pid": os.getpid(),
-            "tid": threading.get_native_id(),
+            "pid": _pid,
+            "tid": _tid(),
             "args": args,
         })
 
@@ -316,8 +701,8 @@ class Tracer:
             "cat": cat or "default",
             "ph": "C",
             "ts": _now_us(),
-            "pid": os.getpid(),
-            "tid": threading.get_native_id(),
+            "pid": _pid,
+            "tid": _tid(),
             "args": {"value": float(value)},
         })
 
@@ -338,6 +723,7 @@ class Tracer:
             cur is not None
             and cur.trace_id is not None
             and not self._keep(cur.trace_id)
+            and self._tail is None
         ):
             return None  # head-dropped trace: flow_end no-ops on None
         fid = flow_id or _new_id()
@@ -367,8 +753,8 @@ class Tracer:
             "ph": ph,
             "id": fid,
             "ts": _now_us(),
-            "pid": os.getpid(),
-            "tid": threading.get_native_id(),
+            "pid": _pid,
+            "tid": _tid(),
             "args": args,
         }
         if ph == "f":
@@ -392,9 +778,26 @@ class Tracer:
             return _NOOP
         return _Activation(_RemoteParent(ctx["tid"], ctx["sid"]))
 
-    def _record(self, ev: dict[str, Any]) -> None:
+    def _record(self, ev: dict[str, Any], tail_seal: bool = False) -> None:
+        tail = self._tail
+        if tail is not None:
+            tid = (ev.get("args") or {}).get("trace_id")
+            # tail routing happens BEFORE the ring lock (TailCapture
+            # takes its own lock and may call _append_events, which
+            # takes the ring lock — one consistent order: tail -> ring)
+            if tid is not None:
+                if tail_seal:
+                    if tail.seal_event(tid, ev, self):
+                        return
+                elif tail.route(tid, ev, self):
+                    return
         with self._lock:
             self._buf.append(ev)
+
+    def _append_events(self, evs: list[dict[str, Any]]) -> None:
+        """Bulk ring append (the tail layer's promotion path)."""
+        with self._lock:
+            self._buf.extend(evs)
 
     # -- inspection / export ----------------------------------------------
 
@@ -413,9 +816,25 @@ class Tracer:
 
     def flush(self) -> str | None:
         """Export into the armed trace dir (no-op when disabled or no
-        spans were recorded); returns the written path."""
+        spans were recorded); returns the written path. With tail
+        capture armed, the limbo ring (completed-but-unpromoted traces)
+        also lands as a ``tracetail-*.json`` sidecar — the raw material
+        ``merge_trace_dir`` / the critical-path engine rescue when some
+        OTHER process promoted one of those traces."""
         if self._dir is None:
             return None
+        tail = self._tail
+        if tail is not None:
+            limbo = tail.limbo_events()
+            if limbo:
+                write_chrome_trace(
+                    limbo,
+                    os.path.join(
+                        self._dir,
+                        f"tracetail-{self.process_name}-{os.getpid()}.json",
+                    ),
+                    process_names={os.getpid(): self.process_name},
+                )
         if not self.events():
             return None
         name = f"trace-{self.process_name}-{os.getpid()}.json"
@@ -424,8 +843,21 @@ class Tracer:
 
 #: the process's tracer; armed at import when PS_TRACE_DIR is set so
 #: spawned children need no plumbing (the PS_FAULT_PLAN pattern);
-#: PS_TRACE_SAMPLE rides along for head sampling
-tracer = Tracer(os.environ.get(TRACE_DIR_ENV) or None, sample=_env_sample())
+#: PS_TRACE_SAMPLE rides along for head sampling and PS_TRACE_TAIL for
+#: tail capture (on by default for env-armed processes: always-on
+#: tail-biased retention is the point of arming a production run)
+tracer = Tracer(
+    os.environ.get(TRACE_DIR_ENV) or None,
+    sample=_env_sample(),
+    # tail capture only matters when head sampling can DROP something:
+    # at sample=1 every trace is kept and promotion is unreachable, so
+    # arming the layer would add per-event routing for zero benefit
+    tail=(
+        TailCapture(k=_env_tail_k())
+        if _env_tail_k() > 0 and _env_sample() > 1
+        else None
+    ),
+)
 
 _atexit_armed = False
 
@@ -453,13 +885,27 @@ def configure(
     capacity: int = DEFAULT_CAPACITY,
     process_name: str = "",
     sample: int = 1,
+    tail: bool = False,
+    tail_k: int = DEFAULT_TAIL_K,
+    tail_limbo: int = DEFAULT_TAIL_LIMBO,
 ) -> Tracer:
     """Replace the global tracer (arm with a dir, disarm with ``""``/
     ``None``; ``sample=N`` records 1/N of traces, keyed off the trace
-    id). The previous buffer is dropped — configure at process start,
-    before instrumented code runs."""
+    id; ``tail=True`` arms tail-biased retention — head-dropped traces
+    buffer until completion and promote on slowest-K / anomaly / p99
+    breach instead of dying at the sampler; a no-op at ``sample=1``,
+    where nothing is ever head-dropped and the layer would only add
+    per-event routing cost). The previous buffer is dropped — configure
+    at process start, before instrumented code runs."""
     global tracer
-    tracer = Tracer(trace_dir or None, capacity, process_name, sample=sample)
+    tracer = Tracer(
+        trace_dir or None, capacity, process_name, sample=sample,
+        tail=(
+            TailCapture(k=tail_k, limbo_events=tail_limbo)
+            if tail and tail_k > 0 and sample > 1
+            else None
+        ),
+    )
     if tracer.enabled:
         _arm_atexit()
     return tracer
@@ -474,8 +920,11 @@ def span(name: str, cat: str = "", **args: Any):
     return tracer.span(name, cat, **args)
 
 
-def instant(name: str, cat: str = "", **args: Any) -> None:
-    tracer.instant(name, cat, **args)
+def instant(
+    name: str, cat: str = "", ctx: dict[str, str] | None = None,
+    **args: Any,
+) -> None:
+    tracer.instant(name, cat, ctx=ctx, **args)
 
 
 def counter(name: str, value: float, cat: str = "") -> None:
@@ -560,19 +1009,61 @@ def write_chrome_trace(
     return path
 
 
+def read_trace_dir(
+    trace_dir: str, out_name: str = "trace-merged.json"
+) -> tuple[list[dict[str, Any]], list[dict[str, Any]]]:
+    """The capture-dir reader shared by :func:`merge_trace_dir` and the
+    critical-path engine: ``(main_events, sidecar_events)`` from the
+    ``trace-*.json`` main files and ``tracetail-*.json`` tail-capture
+    sidecars (the merged file and torn/foreign files are skipped — a
+    postmortem works with whatever survived)."""
+    main: list[dict[str, Any]] = []
+    side: list[dict[str, Any]] = []
+    for fn in sorted(os.listdir(trace_dir)):
+        if not fn.endswith(".json") or fn == out_name:
+            continue
+        if fn.startswith("trace-"):
+            bucket = main
+        elif fn.startswith("tracetail-"):
+            bucket = side
+        else:
+            continue
+        try:
+            with open(os.path.join(trace_dir, fn)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        bucket.extend(doc.get("traceEvents", []))
+    return main, side
+
+
+def rescue_sidecar_events(
+    main: list[dict[str, Any]], side: list[dict[str, Any]]
+) -> list[dict[str, Any]]:
+    """The cross-process rescue rule, in ONE place: sidecar (limbo)
+    events join the capture iff some main file retained their trace id
+    — the process that saw the tail latency promoted the trace; the
+    processes whose segments looked fast locally only limbo'd theirs.
+    ``M`` metadata rides along unconditionally (harmless duplicates)."""
+    if not side:
+        return []
+    promoted = {
+        (e.get("args") or {}).get("trace_id") for e in main
+    } - {None}
+    return [
+        e for e in side
+        if e.get("ph") == "M"
+        or (e.get("args") or {}).get("trace_id") in promoted
+    ]
+
+
 def merge_trace_dir(trace_dir: str, out_name: str = "trace-merged.json") -> str:
     """Combine every per-process ``trace-*.json`` in ``trace_dir`` into one
     Perfetto-loadable file (distinct pids keep processes as separate
-    tracks). Returns the merged file's path."""
-    events: list[dict[str, Any]] = []
-    for fn in sorted(os.listdir(trace_dir)):
-        if not (fn.startswith("trace-") and fn.endswith(".json")):
-            continue
-        if fn == out_name:
-            continue
-        with open(os.path.join(trace_dir, fn)) as f:
-            doc = json.load(f)
-        events.extend(doc.get("traceEvents", []))
+    tracks), with ``tracetail-*.json`` sidecar events rescued per
+    :func:`rescue_sidecar_events`. Returns the merged file's path."""
+    events, sidecar = read_trace_dir(trace_dir, out_name)
+    events.extend(rescue_sidecar_events(events, sidecar))
     # stable cross-process ordering: metadata first, then by timestamp
     events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
     out = os.path.join(trace_dir, out_name)

@@ -1,0 +1,38 @@
+"""Child process for tests/test_whylate.py's acceptance drill: one
+shard-server process with tracing armed (PS_TRACE_DIR + PS_TRACE_SAMPLE)
+AND tail capture on, plus whatever chaos PS_FAULT_PLAN injects (the
+drill arms a per-cmd delay fault so the wire segment is the culprit).
+Prints its RPC address, serves until the parent's shutdown command, then
+exports its trace file and tail sidecar.
+
+Usage: python _whylate_child_server.py
+"""
+
+from __future__ import annotations
+
+
+def main() -> None:
+    import os
+
+    from parameter_server_tpu.kv.updaters import Sgd
+    from parameter_server_tpu.parallel.multislice import ShardServer
+    from parameter_server_tpu.utils import trace
+    from parameter_server_tpu.utils.keyrange import KeyRange
+
+    # env-armed at import already; re-configure for a readable export
+    # name, the inherited sample rate, and tail capture (the production
+    # run_node arming path)
+    trace.configure(
+        os.environ[trace.TRACE_DIR_ENV],
+        process_name="server-0",
+        sample=trace._env_sample(),
+        tail=True,
+    )
+    srv = ShardServer(Sgd(eta=0.1), KeyRange(0, 4096))
+    print("ADDR", srv.address, flush=True)
+    srv.serve_forever()  # until the parent's shutdown frame
+    trace.tracer.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -198,6 +198,64 @@ class TestSelfHealingRpc:
             cli.close()
             srv.stop()
 
+    def test_heal_retries_when_replacement_dies_under_resend(
+        self, monkeypatch
+    ):
+        """Liveness regression (surfaced by the ISSUE 15 chaos drills
+        under CPU load): while a heal resends the stranded window on its
+        freshly installed socket, the server may sever that socket; the
+        replacement's reader sees EOF while ``_healing`` is still True
+        and correctly DEFERS to the in-flight heal (no second heal) —
+        but the heal never re-checked its socket after the resend, so it
+        declared victory over a dead connection. End state: pending
+        entries claimed ``sent``, ``sock=None``, ``healing=False`` — no
+        writer, no reader, no healer, futures parked forever. The heal
+        must notice the swap and retry within its deadline window."""
+        import threading as threading_mod
+
+        from parameter_server_tpu.parallel import control as control_mod
+
+        # first echo applies, reply lost, conn severed -> ONE heal fires
+        srv, handler = _serve("disconnect,cmd=echo,every=1,max=1")
+        cli = RpcClient(srv.address, reconnect_timeout_s=20.0)
+        reconnects0 = wire_counters.get("rpc_reconnects")
+        real = control_mod._send_gather
+        fired = []
+
+        def racy_send(sock, bufs):
+            real(sock, bufs)
+            if (
+                not fired
+                and cli._healing
+                and threading_mod.current_thread().name == "ps-rpc-reader"
+            ):
+                # the heal's own resend: simulate the replacement dying
+                # right under it — its reader defers (healing is True)
+                # and nulls/closes the socket, the exact interleaving
+                fired.append(1)
+                cli._conn_died(sock, cli._gen)
+
+        monkeypatch.setattr(control_mod, "_send_gather", racy_send)
+        try:
+            rep, _ = cli.call("echo")  # must complete, not park forever
+            assert rep["n"] == 1
+            assert handler.applies == 1  # replayed, never re-applied
+            assert fired, "the race interleaving was not exercised"
+            # the heal reconnected at least twice: the replacement that
+            # died under the resend, then the one that landed (the reply
+            # may resolve the future while the retry is still running —
+            # wait for the heal to settle before asserting)
+            deadline = time.monotonic() + 10.0
+            while (
+                wire_counters.get("rpc_reconnects") < reconnects0 + 2
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert wire_counters.get("rpc_reconnects") >= reconnects0 + 2
+        finally:
+            cli.close()
+            srv.stop()
+
     def test_raw_frames_bypass_dedup(self):
         # legacy frames without _cid/_seq keep the old contract
         import socket as socket_mod

@@ -121,18 +121,22 @@ class TestTimeSeriesRing:
 def validate_openmetrics(text: str) -> dict[str, str]:
     """Minimal OpenMetrics validator: returns {family: type}. Asserts
     the EOF terminator, name grammar, counter ``_total`` suffixes,
-    histogram bucket coherence (cumulative, +Inf == count), and the
+    histogram bucket coherence (cumulative, +Inf == count), the
     ISSUE 14 always-present series — ``ps_build_info`` (info-metric
     gauge with version/role/rank labels) and
     ``ps_audit_violations_total`` (explicit 0 on a clean node, so "no
-    violations" and "audit plane absent" scrape differently)."""
+    violations" and "audit plane absent" scrape differently) — and
+    (ISSUE 15) the exemplar syntax: ``# {labels} value [ts]`` suffixes
+    are accepted ONLY on histogram ``_bucket`` samples and must carry a
+    well-formed label set and a parseable value."""
     lines = text.splitlines()
     assert lines, "empty exposition"
     assert lines[-1] == "# EOF", "must end with the EOF terminator"
     name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
     sample_re = re.compile(
         r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-        r"(?P<labels>\{[^{}]*\})? (?P<value>[^ ]+)$"
+        r"(?P<labels>\{[^{}]*\})? (?P<value>[^ ]+)"
+        r"(?P<exemplar> # \{[^{}]*\} [^ ]+( [^ ]+)?)?$"
     )
     types: dict[str, str] = {}
     samples: list[tuple[str, str, float]] = []
@@ -149,6 +153,16 @@ def validate_openmetrics(text: str) -> dict[str, str]:
         else:
             m = sample_re.match(ln)
             assert m, f"malformed sample line: {ln!r}"
+            if m["exemplar"]:
+                # exemplars attach to histogram buckets only, with a
+                # label set and a parseable value (ts optional)
+                assert m["name"].endswith("_bucket"), ln
+                ex = m["exemplar"]
+                assert re.match(
+                    r"^ # \{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+                    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\} ", ex
+                ), ln
+                float(ex.rsplit("} ", 1)[1].split(" ")[0])
             samples.append(
                 (m["name"], m["labels"] or "", float(m["value"]))
             )
@@ -217,6 +231,33 @@ class TestOpenMetrics:
         # count-valued series expose raw-valued buckets, no _seconds
         assert types.get("ps_server_apply_batch_n") == "histogram"
         assert 'proc="worker-0"' in text
+
+    def test_exemplars_render_and_validate(self):
+        """ISSUE 15 satellite: the window's max-latency observation
+        carries its trace id through ``/metrics`` as a standard
+        OpenMetrics exemplar on the bucket containing it — the link
+        from a dashboard p99 spike to the retained tail trace. The
+        validator requires the exemplar grammar (bucket-only, labeled,
+        parseable value)."""
+        # consume whatever exemplar window earlier traced tests left so
+        # this observation is deterministically the window max
+        latency_histograms.snapshot(roll_exemplars=True)
+        latency_histograms.observe(
+            "client.push", 0.008, exemplar="feedfacecafef00d"
+        )
+        text = timeseries.render_openmetrics(
+            telemetry_snapshot(roll_peaks=False), proc="worker-0"
+        )
+        validate_openmetrics(text)
+        ex_lines = [ln for ln in text.splitlines() if " # {" in ln]
+        assert any(
+            'trace_id="feedfacecafef00d"' in ln
+            and ln.startswith("ps_client_push_seconds_bucket")
+            for ln in ex_lines
+        ), ex_lines
+        # the exemplar value sits within its bucket's range (spec) —
+        # the renderer placed it on the 2^13 us = 8.192 ms bucket
+        assert any('le="0.008192"' in ln for ln in ex_lines)
 
     def test_live_scrape_and_healthz(self):
         srv = timeseries.start_metrics_server(0, process_name="scrape-0")

@@ -246,9 +246,11 @@ class TestTelemetrySnapshotMerge:
     def test_snapshot_shape(self):
         s = telemetry_snapshot()
         # key_heat rides along only once some shard server counted keys
-        # (ISSUE 9), so it is optional in the shape contract
+        # (ISSUE 9), slow only once an RPC completion recorded a
+        # slowest-op entry (ISSUE 15), prof only under an armed
+        # profiler (ISSUE 13) — all optional in the shape contract
         assert {"counters", "hists", "timers"} <= set(s) <= {
-            "counters", "hists", "timers", "key_heat"
+            "counters", "hists", "timers", "key_heat", "slow", "prof"
         }
         json.dumps(s)  # wire-serializable
 
