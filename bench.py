@@ -1100,10 +1100,26 @@ def child_ingest() -> dict:
 
 
 _ACK_SERVER_CODE = """
-import sys
+import sys, time
 sys.path.insert(0, {repo!r})
 from parameter_server_tpu.parallel.control import RpcServer
-srv = RpcServer(lambda h, a: ({{"ok": True}}, {{}})).start()
+PTS = int(time.time() * 1e6)  # the "publish" this bench process serves
+FRESH = [False]  # toggled server-side, like the real serving tier
+def _ack(h, a):
+    if h.get("cmd") == "fresh":
+        FRESH[0] = bool(h.get("on"))
+        return ({{"ok": True}}, {{}})
+    if FRESH[0]:
+        # freshness-armed rounds (ISSUE 17): the reply carries the
+        # publish stamp + measured age through the v3 binary slots,
+        # the exact decoration a serving-tier pull reply pays. The
+        # toggle is a control command, not a per-request field: the
+        # armed rounds measure the decoration, not a JSON-tail tax
+        # production requests never carry.
+        now = int(time.time() * 1e6)
+        return ({{"ok": True, "pts": PTS, "_age_us": now - PTS}}, {{}})
+    return ({{"ok": True}}, {{}})
+srv = RpcServer(_ack).start()
 print("ADDR", srv.address, flush=True)
 while not srv._stop.wait(0.5):
     pass
@@ -1227,6 +1243,10 @@ def child_wire_rpc() -> dict:
                 f.result()
             return n / (time.perf_counter() - t0)
 
+        def _freshness(on: bool) -> None:
+            pipelined.call("fresh", on=int(on))
+            lockstep.call("fresh", on=int(on))
+
         # INTERLEAVED rounds, median per-round ratio: shared-host noise
         # (this is a loopback bench on whatever machine the driver uses)
         # hits both modes of a round alike instead of biasing one side
@@ -1338,7 +1358,10 @@ def child_wire_rpc() -> dict:
         # exact cost a live-audited production node pays; ISSUE 15 adds
         # head-sampled tracing at sample=16 WITH tail capture, so the
         # always-on slow-trace retention — pending buffers, promotion
-        # checks, limbo ring — is inside the same ratio). The roller
+        # checks, limbo ring — is inside the same ratio; ISSUE 17 arms
+        # the freshness plane: every armed-round reply carries the
+        # publish stamp + measured age through the v3 binary header
+        # slots, the serving tier's per-reply decoration). The roller
         # runs far above its production cadence (0.1 s vs one roll per
         # heartbeat) and the profiler at its default Hz, so this is a
         # conservative ceiling on what a fully-instrumented node pays.
@@ -1365,9 +1388,11 @@ def child_wire_rpc() -> dict:
                 sample=16, tail=True,
             )
             roller = ts_mod.Roller(0.1)
+            _freshness(True)
             try:
                 on = _rps_pipelined(400)
             finally:
+                _freshness(False)
                 roller.close()
                 prof_mod.configure(0)
                 flightrec.configure(None)
@@ -1388,6 +1413,12 @@ def child_wire_rpc() -> dict:
         out["trace_tail_promoted"] = wire_counters.get(
             "trace_tail_promoted"
         )
+        # ... and proof the freshness decoration engaged: one echoed
+        # age, measured by the server against its own publish stamp
+        _freshness(True)
+        rep, _ = pipelined.call("push", arrays=payload)
+        _freshness(False)
+        out["freshness_echo_age_us"] = int(rep.get("_age_us", -1))
 
         # ISSUE 15's MARGINAL cost, isolated: tracing armed (sample=16)
         # on BOTH sides, tail capture toggled — what the retention layer

@@ -64,12 +64,19 @@ V1_FLAGS = frozenset({
 })
 
 
+#: the flag-constant families across header generations: v1/v2 bits
+#: live in the two original flag bytes; ``_BF3_*`` bits ride the
+#: appended flags3 byte (version 3, the freshness slots) and are gated
+#: by the ``_BVERSION3`` stamp instead of the v2 mask
+_FLAG_PREFIXES = ("_BF_", "_BF2_", "_BF3_")
+
+
 def _flag_names(node: ast.AST) -> set[str]:
     return {
         sub.id
         for sub in ast.walk(node)
         if isinstance(sub, ast.Name)
-        and (sub.id.startswith("_BF_") or sub.id.startswith("_BF2_"))
+        and sub.id.startswith(_FLAG_PREFIXES)
         and not sub.id.endswith("_MASK")
     }
 
@@ -150,7 +157,7 @@ def _module_flags(tree: ast.Module) -> dict[str, int]:
         for t in node.targets:
             if not (
                 isinstance(t, ast.Name)
-                and (t.id.startswith("_BF_") or t.id.startswith("_BF2_"))
+                and t.id.startswith(_FLAG_PREFIXES)
                 and not t.id.endswith("_MASK")
             ):
                 continue
@@ -199,10 +206,27 @@ def _check_codec_tables(
                 "any frame carrying the field)",
             ))
     # version gating: flags beyond the frozen v1 inventory must ride the
-    # v2 mask the encoder stamps the version byte from
+    # v2 mask the encoder stamps the version byte from — except the
+    # ``_BF3_*`` family, which lives in the appended flags3 byte and is
+    # gated by the _BVERSION3 stamp instead (checked below)
     flags = _module_flags(f.tree)
     mask = _mask_members(f.tree)
-    extra = {n for n in flags if n not in V1_FLAGS}
+    bf3 = {n for n in flags if n.startswith("_BF3_")}
+    if bf3:
+        for side, fn in (("encoder", enc), ("decoder", dec)):
+            if not any(
+                isinstance(sub, ast.Name) and sub.id == "_BVERSION3"
+                for sub in ast.walk(fn)
+            ):
+                n = sorted(bf3)[0]
+                out.append(Finding(
+                    "wireproto", f.relpath, flags[n],
+                    f"flag {n} rides the flags3 byte but the {side} "
+                    "never consults _BVERSION3 — v3-slot frames would "
+                    "ship unstamped (or the flags3 byte would be "
+                    "misparsed as a v1/v2 slot)",
+                ))
+    extra = {n for n in flags if n not in V1_FLAGS} - bf3
     if extra and mask is None:
         n = sorted(extra)[0]
         out.append(Finding(

@@ -184,6 +184,35 @@ def _build_parser() -> argparse.ArgumentParser:
         "and scripts",
     )
 
+    rg = sub.add_parser(
+        "ranges",
+        help="the freshness plane's dashboard (`top` over key ranges): "
+        "per-range push/pull rates, bytes moved, apply cost and the "
+        "REALIZED data-age distribution of serves (server-measured "
+        "publish-to-serve age + cache dwell), aggregated cluster-wide "
+        "from the coordinator's retained heartbeat time series, with "
+        "hot-key heat folded onto the owning range",
+    )
+    rg.add_argument("--scheduler", required=True, help="coordinator host:port")
+    rg.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh cadence in seconds",
+    )
+    rg.add_argument(
+        "--window", type=float, default=0.0,
+        help="rate/percentile window in seconds (0 = the coordinator's "
+        "[timeseries] window_s default)",
+    )
+    rg.add_argument(
+        "--once", action="store_true",
+        help="print one frame and exit (scripts / tests)",
+    )
+    rg.add_argument(
+        "--json", action="store_true",
+        help="one-shot machine-readable per-range matrix (implies "
+        "--once)",
+    )
+
     au = sub.add_parser(
         "audit",
         help="the live audit plane (streaming protocol sentinel): "
@@ -326,6 +355,41 @@ def _build_parser() -> argparse.ArgumentParser:
         help="skip the spec<->code conformance diff (models only)",
     )
     ck.add_argument("--json", action="store_true")
+
+    vf = sub.add_parser(
+        "verify",
+        help="the one-shot verification meta-command: chain pslint "
+        "(--baseline gating), psmc protocol checking, optionally a "
+        "live `audit --once` and an offline `whylate --baseline` "
+        "budget gate, and fold their verdicts into ONE tiered exit "
+        "code (0 clean, 2 soft/over-budget only, 1 any hard failure) "
+        "— the single command CI and the bench workflow call",
+    )
+    vf.add_argument(
+        "--lint-baseline", default="", metavar="FILE",
+        help="pass through to `lint --baseline` (omit for a plain "
+        "zero-findings lint)",
+    )
+    vf.add_argument(
+        "--max-states", type=int, default=200_000,
+        help="psmc BFS state cap (see `check --max-states`)",
+    )
+    vf.add_argument(
+        "--scheduler", default="",
+        help="also run `audit --once` against this live coordinator "
+        "(omitted: the audit stage is skipped)",
+    )
+    vf.add_argument(
+        "--whylate", dest="whylate_dir", default="", metavar="DIR",
+        help="also run `whylate` over this trace/blackbox capture dir "
+        "(omitted: the whylate stage is skipped)",
+    )
+    vf.add_argument(
+        "--whylate-baseline", default="", metavar="FILE",
+        help="per-segment latency budgets for the whylate stage (see "
+        "`whylate --baseline`)",
+    )
+    vf.add_argument("--json", action="store_true")
 
     bk = sub.add_parser(
         "backend",
@@ -1003,6 +1067,113 @@ def run_whylate(args: argparse.Namespace) -> int:
     return rc
 
 
+def run_ranges(args: argparse.Namespace) -> int:
+    """The freshness dashboard (``cli ranges``): per-range traffic and
+    realized data-age matrix from the coordinator's ``telemetry``
+    command, auto-refreshing like ``cli top``; ``--once``/``--json``
+    print a single frame for scripts and tests."""
+    import time as time_mod
+
+    from parameter_server_tpu.parallel.control import ControlClient
+    from parameter_server_tpu.utils.slo import format_ranges, ranges_view
+
+    ctl = ControlClient(args.scheduler, retries=5, reconnect_timeout_s=5.0)
+    window = args.window or None
+    try:
+        while True:
+            rep = ctl.telemetry(window_s=window)
+            shown_window = (
+                args.window
+                or next(iter(rep.get("series", {}).values()), {}).get(
+                    "window_s", 0.0
+                )
+            )
+            if args.json:
+                print(json.dumps(
+                    ranges_view(rep, float(shown_window or 0.0)),
+                    default=float,
+                ))
+                return 0
+            frame = format_ranges(rep, float(shown_window or 0.0))
+            if args.once:
+                print(frame)
+                return 0
+            print("\x1b[2J\x1b[H" + frame, flush=True)
+            time_mod.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        ctl.close()
+
+
+def run_verify(args: argparse.Namespace) -> int:
+    """The verification meta-command (``cli verify``): run every armed
+    analysis stage and fold their exit codes into one tiered verdict —
+    1 when ANY stage failed hard (lint findings, model-checker
+    violation, audit violations, whylate hard regression), else 2 when
+    any stage was merely over budget (the whylate/pslint soft tier),
+    else 0. One command, one exit code: what CI and the bench README
+    workflow gate on."""
+    from parameter_server_tpu.analysis.__main__ import (
+        check_main,
+        main as lint_main,
+    )
+
+    stages: list[dict] = []
+
+    def _stage(name: str, fn) -> None:
+        print(f"[verify] {name} ...", flush=True)
+        try:
+            rc = int(fn() or 0)
+        except SystemExit as e:  # argparse/guard exits inside a stage
+            rc = e.code if isinstance(e.code, int) else 1
+        except Exception as e:  # a crashed stage is a hard failure,
+            # not a crashed verify: the remaining stages still run
+            print(f"[verify] {name} crashed: {e}", flush=True)
+            rc = 1
+        stages.append({"stage": name, "exit": rc})
+        print(
+            f"[verify] {name}: " + ("ok" if rc == 0 else f"exit {rc}"),
+            flush=True,
+        )
+
+    lint_argv: list[str] = []
+    if args.lint_baseline:
+        lint_argv += ["--baseline", args.lint_baseline]
+    _stage("lint", lambda: lint_main(lint_argv))
+    _stage(
+        "check",
+        lambda: check_main(["--max-states", str(args.max_states)]),
+    )
+    if args.scheduler:
+        au = argparse.Namespace(
+            scheduler=args.scheduler, interval=2.0, once=True,
+            json=False, recent=20,
+        )
+        _stage("audit", lambda: run_audit(au))
+    if args.whylate_dir:
+        wl = argparse.Namespace(
+            dir=args.whylate_dir, scheduler="", top=5, json=False,
+            baseline=args.whylate_baseline, update_baseline=False,
+        )
+        _stage("whylate", lambda: run_whylate(wl))
+    hard = [s["stage"] for s in stages if s["exit"] not in (0, 2)]
+    soft = [s["stage"] for s in stages if s["exit"] == 2]
+    rc = 1 if hard else (2 if soft else 0)
+    verdict = (
+        f"FAILED ({', '.join(hard)})" if hard
+        else f"over budget ({', '.join(soft)})" if soft
+        else "all stages clean"
+    )
+    if args.json:
+        print(json.dumps({
+            "stages": stages, "hard": hard, "soft": soft, "exit": rc,
+        }))
+    else:
+        print(f"[verify] verdict: {verdict} — exit {rc}")
+    return rc
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.cmd == "lint":
@@ -1104,6 +1275,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "top":
         # no config file: the dashboard reads the live coordinator
         return run_top(args)
+    if args.cmd == "ranges":
+        # no config file: the freshness dashboard reads the live
+        # coordinator (range boundaries ride the series names)
+        return run_ranges(args)
+    if args.cmd == "verify":
+        # no config file: every chained stage is itself config-free
+        return run_verify(args)
     if args.cmd == "audit":
         # no config file: the sentinel reads the live coordinator
         return run_audit(args)

@@ -54,15 +54,25 @@ class CacheEntry:
     they were read at, and the two clocks bounding how long they may be
     served (``expires_at``: the soft TTL, re-armed by revalidation;
     ``filled_at``: when the server last CONFIRMED this version, the
-    anchor of the hard ``max_stale`` ceiling)."""
+    anchor of the hard ``max_stale`` ceiling).
+
+    Freshness plane (ISSUE 17): ``age0_us`` is the server-measured data
+    age (µs since the RCU publish) at the moment the entry was filled
+    or last revalidated — the reply's ``_age_us`` echo. A cached serve
+    at monotonic time ``now`` hands out rows whose realized age is
+    ``age0_us + (now - filled_at)``: the cross-machine term is measured
+    on the SERVER's clock (skew-free) and only the local dwell time is
+    measured here."""
 
     __slots__ = (
         "keys", "values", "version", "filled_at", "expires_at", "rank",
+        "age0_us",
     )
 
     def __init__(
         self, keys: np.ndarray, values: np.ndarray, version: int,
         filled_at: float, expires_at: float, rank: int = 0,
+        age0_us: float = 0.0,
     ):
         self.keys = keys
         self.values = values
@@ -70,6 +80,12 @@ class CacheEntry:
         self.filled_at = filled_at
         self.expires_at = expires_at
         self.rank = rank  # shard namespace of the inverted-index rows
+        self.age0_us = float(age0_us)
+
+    def age_us(self, now: float | None = None) -> float:
+        """Realized age (µs) of these rows if served at ``now``."""
+        now = time.monotonic() if now is None else now
+        return self.age0_us + max(now - self.filled_at, 0.0) * 1e6
 
 
 class ClientKeyCache:
@@ -172,7 +188,7 @@ class ClientKeyCache:
     def put(
         self, sig, keys: np.ndarray, values: np.ndarray, version: int,
         now: float | None = None, as_of: int | None = None,
-        rank: int | None = None,
+        rank: int | None = None, age_us: float | None = None,
     ) -> CacheEntry | None:
         """Install freshly pulled rows (replacing any older entry).
         ``as_of`` is the :attr:`gen` captured when the pull was ISSUED:
@@ -200,7 +216,8 @@ class ClientKeyCache:
         keys = np.array(keys, copy=True)
         values = np.array(values, copy=True)  # own both: callers may reuse
         ent = CacheEntry(
-            keys, values, int(version), now, now + self.ttl_s, int(rank)
+            keys, values, int(version), now, now + self.ttl_s, int(rank),
+            age0_us=float(age_us or 0.0),
         )
         with self._lock:
             if as_of is not None and as_of != self._gen:
@@ -218,17 +235,25 @@ class ClientKeyCache:
         return ent
 
     def revalidated(
-        self, sig, version: int, now: float | None = None
+        self, sig, version: int, now: float | None = None,
+        age_us: float | None = None,
     ) -> None:
         """A ``not_modified`` reply confirmed the entry's version is
         still current: re-arm BOTH clocks — the data is as fresh as the
-        round trip that just verified it."""
+        round trip that just verified it. ``age_us`` re-anchors the
+        realized-age clock off the reply's server-measured ``_age_us``
+        echo; absent (pre-freshness server), the age keeps accumulating
+        from the previous anchor — an unknown age must grow, never
+        reset to zero on a reply that moved no rows."""
         now = time.monotonic() if now is None else now
         with self._lock:
             ent = self._d.get(sig)
             if ent is None:
                 return
             ent.version = int(version)
+            ent.age0_us = (
+                float(age_us) if age_us is not None else ent.age_us(now)
+            )
             ent.filled_at = now
             ent.expires_at = now + self.ttl_s
         wire_counters.inc("serve_cache_validates")

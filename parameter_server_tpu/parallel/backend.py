@@ -329,7 +329,7 @@ def local_socket_backend(
         for r in ranges
     ]
     handles = [
-        ServerHandle(s.address, i, 0, cfg, range_size=r.size)
+        ServerHandle(s.address, i, 0, cfg, range_size=r.size, key_range=r)
         for i, (s, r) in enumerate(zip(servers, ranges))
     ]
     return SocketBackend(

@@ -222,7 +222,15 @@ _BMAGIC = 0xB7  # first header byte; JSON always starts with '{' (0x7B)
 # the new bits, so the v2 decoder reads both layouts; the version byte
 # still hard-rejects anything newer than this build understands.
 _BVERSION = 2
-_BVERSIONS_OK = (1, 2)
+# version 3 = version 2 + the freshness plane (ISSUE 17). Both flag
+# bytes were full, so v3 adds STRUCTURE instead of bits: a third flags
+# byte rides immediately after the fixed prefix, gating the publish-ts
+# and realized-age slots a freshness-stamped pull reply carries. The
+# lowest-version stamping rule below extends naturally — only a frame
+# that actually carries a flags3 slot is stamped 3, so every other
+# frame stays decodable by v1/v2 peers.
+_BVERSION3 = 3
+_BVERSIONS_OK = (1, 2, 3)
 
 # flags1
 _BF_CID = 1
@@ -248,6 +256,14 @@ _BF2_NOT_MODIFIED = 32
 _BF2_VER = 64
 _BF2_IF_NEWER = 128
 _BF2_V2_MASK = _BF2_NOT_MODIFIED | _BF2_VER | _BF2_IF_NEWER
+# flags3 (version 3; freshness plane): the wall-clock publish timestamp
+# (µs since epoch) stamped at RCU publish, and the server-computed
+# realized age of the data at serve time (µs). First-class slots
+# because a serving tier pays them on EVERY pull reply; any
+# slot-unfit value (non-int, out of range) rides the JSON tail like
+# every other residual field — the codec never gates correctness.
+_BF3_PTS = 1
+_BF3_AGE = 2
 
 _BFIX = struct.Struct("<BBBBBH")  # magic, version, flags1, flags2, cmd_id, narrays
 _I32 = struct.Struct("<i")
@@ -300,10 +316,10 @@ def _encode_bin_header(h: dict[str, Any], metas: list) -> bytes | None:
     length json.dumps would have produced (running the real thing per
     frame is exactly the cost this codec removes) — accurate to a few
     bytes per frame."""
-    flags1 = flags2 = 0
+    flags1 = flags2 = flags3 = 0
     cmd_id = 0
     cmd_b = cid_b = seq_b = rseq_b = worker_b = sig_b = codec_b = None
-    ver_b = ifn_b = None
+    ver_b = ifn_b = pts_b = age_b = None
     extra: dict[str, Any] | None = None
     est = 14  # {} plus "arrays": []
     for k, v in h.items():
@@ -370,11 +386,28 @@ def _encode_bin_header(h: dict[str, Any], metas: list) -> bytes | None:
         elif k == "not_modified" and v is True:
             flags2 |= _BF2_NOT_MODIFIED
             est += 21
+        elif (
+            k == "pts" and type(v) is int and 0 <= v < (1 << 63)
+        ):
+            flags3 |= _BF3_PTS
+            pts_b = _I64.pack(v)
+            est += 9 + len(str(v))
+        elif (
+            k == "_age_us" and type(v) is int and 0 <= v < (1 << 63)
+        ):
+            flags3 |= _BF3_AGE
+            age_b = _I64.pack(v)
+            est += 13 + len(str(v))
         else:
             if extra is None:
                 extra = {}
             extra[k] = v
     parts: list[bytes] = [b""]  # slot 0: the fixed prefix, packed below
+    if flags3:
+        # the flags3 byte rides directly after the fixed prefix, BEFORE
+        # the flags1/flags2 slots — a v3 decoder reads it first, then
+        # falls through the shared v1/v2 slot walk
+        parts.append(_B1[flags3])
     if cmd_b is not None:
         parts.append(cmd_b)
     if cid_b is not None:
@@ -393,6 +426,10 @@ def _encode_bin_header(h: dict[str, Any], metas: list) -> bytes | None:
         parts.append(ver_b)
     if ifn_b is not None:
         parts.append(ifn_b)
+    if pts_b is not None:
+        parts.append(pts_b)
+    if age_b is not None:
+        parts.append(age_b)
     if len(metas) > 0xFFFF:
         return None
     for name, dt, shape, clen in metas:
@@ -423,8 +460,15 @@ def _encode_bin_header(h: dict[str, Any], metas: list) -> bytes | None:
     # frame with no v2 slots is byte-identical to a v1 frame, and
     # stamping it 1 keeps every non-serving frame decodable by v1 peers
     # (a binary-negotiated mixed cluster must degrade, not livelock —
-    # the _bh ack carries no version, so the stamp is the only guard)
-    ver_byte = _BVERSION if flags2 & _BF2_V2_MASK else 1
+    # the _bh ack carries no version, so the stamp is the only guard).
+    # Only a frame carrying a flags3 slot is stamped 3: the freshness
+    # fields are reply decoration, so a v1/v2 peer that never asked for
+    # them never receives a version-3 frame either.
+    ver_byte = (
+        _BVERSION3 if flags3
+        else _BVERSION if flags2 & _BF2_V2_MASK
+        else 1
+    )
     parts[0] = _BFIX.pack(
         _BMAGIC, ver_byte, flags1, flags2, cmd_id, len(metas)
     )
@@ -444,6 +488,10 @@ def _decode_bin_header(raw: memoryview) -> dict[str, Any]:
     if version not in _BVERSIONS_OK:
         raise ValueError(f"unsupported binary header version {version}")
     off = _BFIX.size
+    flags3 = 0
+    if version >= _BVERSION3:
+        flags3 = buf[off]
+        off += 1
     h: dict[str, Any] = {}
     if flags1 & _BF_CMD_STR:
         n = buf[off]
@@ -489,6 +537,12 @@ def _decode_bin_header(raw: memoryview) -> dict[str, Any]:
         off += 8
     if flags2 & _BF2_IF_NEWER:
         h["if_newer"] = _I64.unpack_from(buf, off)[0]
+        off += 8
+    if flags3 & _BF3_PTS:
+        h["pts"] = _I64.unpack_from(buf, off)[0]
+        off += 8
+    if flags3 & _BF3_AGE:
+        h["_age_us"] = _I64.unpack_from(buf, off)[0]
         off += 8
     if flags1 & _BF_OK_TRUE:
         h["ok"] = True
@@ -872,13 +926,25 @@ class RpcServer:
             feature advert (``_feat``), and stamp the server-observed
             service time (``_svc_us`` — the client's latency-forensics
             planes split wall time into wire vs server from this echo)
-            on a COPY — ``rep`` may be a shared reply-cache dict."""
+            on a COPY — ``rep`` may be a shared reply-cache dict.
+
+            Freshness plane (ISSUE 17): a handler that stamped its
+            reply with the RCU publish timestamp (``pts``, µs epoch)
+            gets the realized data age (``_age_us``) computed HERE,
+            per serve — the publish ts is version-constant and may
+            ride shared/cached reply dicts, but the age each consumer
+            sees depends on when THIS serve happened, and the
+            publish/serve clocks belong to the same process, so the
+            delta is skew-free."""
+            pts_d = rep.get("pts")
             if (
                 seq_d is None and not adv_d and feat_d is None
-                and svc_us is None
+                and svc_us is None and pts_d is None
             ):
                 return rep
             rep = dict(rep)
+            if type(pts_d) is int:
+                rep["_age_us"] = max(int(time.time() * 1e6) - pts_d, 0)
             if seq_d is not None:
                 rep["_rseq"] = seq_d
             if adv_d:

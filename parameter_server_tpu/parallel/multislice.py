@@ -87,7 +87,9 @@ from parameter_server_tpu.utils.flightrec import watchdog
 from parameter_server_tpu.utils.heartbeat import HeartbeatReporter, host_stats
 from parameter_server_tpu.utils.keyrange import KeyRange
 from parameter_server_tpu.utils.metrics import (
+    RangeScope,
     key_heat,
+    latency_histograms,
     observe_scalar,
     race_track,
     telemetry_snapshot,
@@ -253,10 +255,19 @@ class ShardServer:
         self._ver_base = (
             int.from_bytes(os.urandom(3), "big") & ((1 << 23) - 1)
         ) << 40
-        self._pub: tuple[dict[str, Any], int] = (
+        # freshness plane (ISSUE 17): the publish timestamp (µs epoch)
+        # rides the tuple so the lock-free reader captures (state,
+        # version, publish-ts) in ONE reference swap — a pull reply's
+        # age is measured against exactly the publish its rows came
+        # from, never a neighbour publish.
+        self._pub: tuple[dict[str, Any], int, int] = (
             updater.init(key_range.size, vdim), self._ver_base + 1,
+            int(time.time() * 1e6),
         )
         self._serve_cfg = svcfg
+        # freshness plane: this range's traffic/age matrix (per-range
+        # counters+hists riding the ordinary telemetry namespaces)
+        self._range_scope = RangeScope(key_range.begin, key_range.end)
         # single-flight encoded-pull cache: (sig, version, codec) -> entry
         self._enc_lock = threading.Lock()
         self._enc_cache: OrderedDict[tuple, _EncodeEntry] = OrderedDict()
@@ -386,7 +397,7 @@ class ShardServer:
         checkpoint load) goes through here, so a pull reply's ``ver``
         always identifies exactly the table its rows came from."""
         ver = self._pub[1] + 1
-        self._pub = (new_state, ver)
+        self._pub = (new_state, ver, int(time.time() * 1e6))
         # flight recorder: every publish, whatever the writer — the
         # postmortem's version-regression detector reads this stream
         flightrec.record("rcu.publish", ver=ver)
@@ -725,6 +736,14 @@ class ShardServer:
                     [p.cid, p.seq] for p in todo if p.cid is not None
                 ],
             )
+        if todo:
+            # per-range matrix: applied pushes, their payload bytes and
+            # the jitted-apply cost (the batch's, once — the coalesced
+            # apply IS this range's cost, not per-push)
+            self._range_scope.push(
+                len(todo), sum(int(p.grad.nbytes) for p in todo)
+            )
+            self._range_scope.apply(max(t_apply1 - t_apply0, 0.0))
         with self._ctr_lock:
             self.counters["pushes"] += len(todo)
             self.counters["apply_batches"] += 1
@@ -959,6 +978,7 @@ class ShardServer:
                         self._record_push(cid, seq)
                 serial_ver = self.version
             self._bump("pushes")
+            self._range_scope.push(1, int(np.asarray(g).nbytes))
             flightrec.record(
                 "apply.commit", ver=serial_ver, pushes=1,
                 pairs=[[cid, seq]] if cid is not None else [],
@@ -1034,7 +1054,7 @@ class ShardServer:
         # tuple per batch, never mutates one in place), so this pull
         # sees the pre- or post-batch table — never a torn mix, never a
         # version that disagrees with its rows — without the write lock
-        state, ver = self._pub  # psl: ignore[rcu]: THE sanctioned lock-free read — one atomic capture of the whole (state, version) tuple; the state/version properties would be two captures and could pair rows with a foreign version
+        state, ver, pts = self._pub  # psl: ignore[rcu]: THE sanctioned lock-free read — one atomic capture of the whole (state, version, publish-ts) tuple; the state/version properties would be two captures and could pair rows with a foreign version
         ifn = h.get("if_newer")
         sv = bool(h.get("sv")) or ifn is not None
         if ifn is not None and int(ifn) == ver:
@@ -1043,7 +1063,14 @@ class ShardServer:
             self._bump("pulls")
             self._bump("not_modified")
             wire_counters.inc("serve_not_modified")
-            return {"ok": True, "not_modified": True, "ver": ver}, {}
+            self._range_scope.pull(0)
+            # pts: the publish ts of the snapshot the client's cached
+            # rows ARE — the wire layer turns it into a per-serve
+            # ``_age_us`` (see control.decorated), and the client
+            # re-anchors its cache entry's age off this revalidation
+            return {
+                "ok": True, "not_modified": True, "ver": ver, "pts": pts,
+            }, {}
         if ifn is not None and h.get("shed_ok") and self.overloaded():
             # shed: the client promised a cached fallback within its
             # staleness ceiling — tell it to keep serving that and come
@@ -1077,6 +1104,12 @@ class ShardServer:
                     self._bump("pulls")
                     self._bump("encode_reuse")
                     wire_counters.inc("serve_encode_reuse")
+                    self._range_scope.pull(
+                        sum(a.nbytes for a in ent.arrays.values())
+                    )
+                    self._range_scope.age(
+                        max(time.time() - pts / 1e6, 0.0)
+                    )
                     return ent.rep, ent.arrays
                 ent = None  # owner failed or timed out: encode ourselves
         try:
@@ -1087,7 +1120,7 @@ class ShardServer:
             # materialization per step just because its sigs went hot
             rep, out = self._encode_pull(
                 state, ver, keys, h, qn, hot and ifn is not None,
-                with_ver=sv,
+                with_ver=sv, pts=pts,
             )
         except BaseException:
             if ent is not None:
@@ -1095,6 +1128,10 @@ class ShardServer:
             raise
         self._bump("pulls")
         self._bump("pull_encodes")
+        # per-range matrix: rows left this range at this snapshot's age
+        # (publish and serve clocks are the same process's — skew-free)
+        self._range_scope.pull(sum(a.nbytes for a in out.values()))
+        self._range_scope.age(max(time.time() - pts / 1e6, 0.0))
         if ent is not None:
             self._enc_fill(ck, ent, rep, out)
         return rep, out
@@ -1122,7 +1159,7 @@ class ShardServer:
     def _encode_pull(
         self, state: dict[str, Any], ver: int, keys: np.ndarray,
         h: dict[str, Any], qn: int, snap: bool = False,
-        with_ver: bool = False,
+        with_ver: bool = False, pts: int = 0,
     ) -> tuple[dict[str, Any], Arrays]:
         """Gather + encode one pull reply from an RCU snapshot (shared
         verbatim across clients by the single-flight cache — nothing
@@ -1166,10 +1203,16 @@ class ShardServer:
             rep = {"ok": True, "codec": qn, "qseg": qz.seg}
             if with_ver:  # see _handle_pull: only version-aware clients
                 rep["ver"] = ver
+                if pts:
+                    rep["pts"] = pts  # freshness: version-constant, so
+                    # safe on single-flight-shared replies; the wire
+                    # layer derives each serve's _age_us from it
             return rep, {"q": q, "qs": qs}
         rep = {"ok": True, "zip": h.get("zip", False)}
         if with_ver:
             rep["ver"] = ver
+            if pts:
+                rep["pts"] = pts
         return rep, {"w": w.ravel()}
 
     def _decode_grad(self, h: dict[str, Any], arrays: Arrays) -> np.ndarray:
@@ -1214,6 +1257,7 @@ class ServerHandle:
         reconnect_timeout_s: float | None = None,
         serving: bool = False,
         key_cache=None,
+        key_range: KeyRange | None = None,
     ):
         """``serving=True`` marks this handle as part of the read-mostly
         serving tier: with ``[serve] cache`` on, it arms the client-side
@@ -1228,11 +1272,21 @@ class ServerHandle:
         invalidation stays exact because every handle's pushes
         invalidate the shared instance under its own rank. The
         training tier NEVER passes serving=True: a trainer's staleness
-        contract is the SSP clock, not a TTL (see ``_connect_servers``)."""
+        contract is the SSP clock, not a TTL (see ``_connect_servers``).
+
+        ``key_range`` (optional) names the server range this handle
+        proxies: with it, every serve this CLIENT answers — cached,
+        bounded-stale, shed-fallback or fresh off the wire — books its
+        realized data age into that range's matrix alongside the
+        server's own bookings (freshness plane, ISSUE 17)."""
         import itertools
 
         self.rank = rank
         self.worker = worker
+        self._range_scope = (
+            RangeScope(key_range.begin, key_range.end)
+            if key_range is not None else None
+        )
         self._kcache = None
         if serving and cfg.serve.cache:
             from parameter_server_tpu.filters.keycache import ClientKeyCache
@@ -1851,6 +1905,22 @@ class ServerHandle:
 
     # -- client-side versioned key cache (serving handles only) -----------
 
+    def _book_serve_age(self, age_us: float, src: str) -> None:
+        """Book the realized data age ONE serve handed its consumer
+        (freshness plane, ISSUE 17): the global ``serve.age`` histogram
+        (what `cli top`'s age column and the ``pull_age_ms`` SLO read),
+        this handle's per-range matrix when it knows its range, and the
+        flight recorder (a shed-stale serve near the staleness ceiling
+        is exactly the context a postmortem wants on the timeline)."""
+        age_s = max(float(age_us), 0.0) / 1e6
+        latency_histograms.observe("serve.age", age_s)
+        if self._range_scope is not None:
+            self._range_scope.age(age_s)
+        flightrec.record(
+            "freshness.serve", rank=self.rank, src=src,
+            age_us=int(age_us),
+        )
+
     def _cache_try(
         self, local_keys: np.ndarray
     ) -> tuple[np.ndarray | None, dict[str, Any], str, Any, bool, int]:
@@ -1881,6 +1951,7 @@ class ServerHandle:
             return None, {"sv": 1}, sig, None, False, gen
         if self._kcache.fresh(ent):
             wire_counters.inc("serve_cache_hits")
+            self._book_serve_age(ent.age_us(), "cache")
             # a copy, not the cached buffer: callers own their rows and
             # may scribble on them; the cache must stay pristine
             return ent.values.copy(), {}, sig, ent, False, gen
@@ -1889,6 +1960,7 @@ class ServerHandle:
                 # another thread's refresh is in flight: serve the
                 # bounded-stale rows rather than duplicate its RTT
                 wire_counters.inc("serve_cache_stale_hits")
+                self._book_serve_age(ent.age_us(), "stale")
                 return ent.values.copy(), {}, sig, ent, False, gen
             # past the staleness ceiling: correctness wins — do our own
             # wire pull alongside the in-flight refresh
@@ -1911,6 +1983,7 @@ class ServerHandle:
         only stops the entry from being revalidated in place. ``own``
         releases this pull's single-flight refresh claim."""
         try:
+            age = rep.get("_age_us")  # server-measured realized age
             if rep.get("not_modified") and ent is not None:
                 if rep.get("shed"):
                     # the server shed our revalidation: keep serving the
@@ -1920,8 +1993,17 @@ class ServerHandle:
                     self._kcache.shed_backoff(
                         sig, float(rep.get("retry_after_ms", 20)) / 1e3
                     )
+                    # no age echo on a shed reply (nothing validated):
+                    # the realized age is the entry's own, still growing
+                    self._book_serve_age(ent.age_us(), "shed")
                 else:
-                    self._kcache.revalidated(sig, int(rep["ver"]))
+                    self._kcache.revalidated(
+                        sig, int(rep["ver"]), age_us=age,
+                    )
+                    self._book_serve_age(
+                        age if age is not None else ent.age_us(),
+                        "revalidate",
+                    )
                 return ent.values.copy()
             vals = self._decode_pull(out)
             ver = rep.get("ver")
@@ -1931,8 +2013,10 @@ class ServerHandle:
                 # than resurrect possibly pre-push rows
                 self._kcache.put(
                     sig, local_keys, vals, int(ver), as_of=gen,
-                    rank=self.rank,
+                    rank=self.rank, age_us=age,
                 )
+                if age is not None:
+                    self._book_serve_age(age, "pull")
             return vals
         finally:
             if own:
@@ -2193,7 +2277,8 @@ def _connect_servers(
         handles.append(
             ServerHandle(
                 fields["addr"], s, worker_rank, cfg,
-                range_size=ranges[s].size, resolve_addr=resolve,
+                range_size=ranges[s].size, key_range=ranges[s],
+                resolve_addr=resolve,
                 # the TRAINING tier: never a serving handle. A trainer's
                 # staleness contract is the SSP clock (bounded delay in
                 # steps), and a TTL cache would stack a second, time-based

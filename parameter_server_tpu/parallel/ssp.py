@@ -22,7 +22,7 @@ from collections.abc import Callable
 from typing import Any
 
 from parameter_server_tpu.utils import flightrec
-from parameter_server_tpu.utils.metrics import wire_counters
+from parameter_server_tpu.utils.metrics import observe_scalar, wire_counters
 
 
 class DispatchWindow:
@@ -179,8 +179,17 @@ class SSPClock:
             return True
         target = step - self.max_delay - 1
         with self._cv:
-            if self._min_finished() >= target:
-                return True  # gate already open: no blocked time to book
+            mf = self._min_finished()
+            if mf >= target:
+                # gate already open: no blocked time to book — but the
+                # REALIZED staleness of this pass still gets recorded
+                # (freshness plane, ISSUE 17): the bound only caps the
+                # lag; how much of the allowance workers actually
+                # consume is the distribution `cli ranges`/the
+                # ssp_lag_clocks SLO read, and the un-blocked passes
+                # are most of it
+                self._observe_lag(step, mf)
+                return True
             t0 = time.perf_counter()
             self._waiters += 1
             try:
@@ -189,6 +198,7 @@ class SSPClock:
                 )
             finally:
                 self._waiters -= 1
+            mf = self._min_finished()
             blocked = time.perf_counter() - t0
             self._blocked_s[worker] += blocked
             self._blocked_n[worker] += 1
@@ -196,6 +206,8 @@ class SSPClock:
                 int(sum(self._blocked_s) * 1e3) - self._blocked_ms_booked
             )
             self._blocked_ms_booked += whole_ms
+        if ok:
+            self._observe_lag(step, mf)
         # live-ops signal (ISSUE 13): blocked time as a counter, so the
         # coordinator's time-series ring exposes a cluster-visible
         # "ms blocked per second" rate the [slo] engine alerts on
@@ -206,6 +218,18 @@ class SSPClock:
             blocked_ms=round(blocked * 1e3, 3), granted=ok,
         )
         return ok
+
+    def _observe_lag(self, step: int, min_finished: int) -> None:
+        """Record the realized clock lag of one GRANTED gate pass: how
+        many steps ahead of the slowest finished worker this step runs
+        (0 = lockstep; ``max_delay`` = the whole allowance consumed).
+        Count-valued series (``.n``): rides the telemetry plane raw, so
+        ``p99(ssp.lag_clocks.n)`` is directly comparable to the
+        configured bound — enforced vs realized staleness on one
+        chart."""
+        observe_scalar(
+            "ssp.lag_clocks.n", max(step - 1 - min_finished, 0)
+        )
 
     def finish(self, worker: int, step: int) -> None:
         with self._cv:

@@ -525,6 +525,14 @@ class SloConfig:
         "ssp_blocked_ms rate:ssp_blocked_ms <= 500",
         "apply_queue_depth p99:server.apply_queue.n <= 192",
         "replication_lag_s p99:replication_lag_s <= 1",
+        # freshness plane (ISSUE 17): realized data age of client
+        # serves (server-measured _age_us echo + local cache dwell) and
+        # realized SSP staleness at the gate. Both are dormant until a
+        # freshness-armed serve/gate emits the series — the shipped
+        # thresholds are the paper's serving-tier defaults (age under a
+        # second; lag within the configured bound's usual allowance)
+        "pull_age_ms p99:serve.age <= 1000",
+        "ssp_lag_clocks p99:ssp.lag_clocks.n <= 8",
         # the audit plane's alert hook (ISSUE 14): the coordinator bumps
         # audit_violations in its own ring, so a sustained violation
         # stream pages through the same burn-rate machinery; a clean

@@ -375,6 +375,108 @@ def observe_scalar(name: str, value: float) -> None:
     latency_histograms.observe(name, value / 1e6)
 
 
+#: range-series naming (ISSUE 17 freshness plane): every per-key-range
+#: metric is an ORDINARY counter/histogram whose name carries a
+#: ``range.<begin>-<end>.`` prefix. The encoding is the whole design:
+#: the heartbeat piggyback, the coordinator's delta rings,
+#: merge_telemetry, beat saturation and the SLO engine all treat the
+#: series like any other, so the per-range matrix rides the existing
+#: plumbing end to end; only render time (the OpenMetrics endpoint,
+#: ``cli ranges``) parses the prefix back into a bounded label.
+RANGE_PREFIX = "range."
+
+#: the overflow bucket every cardinality guard folds excess ranges into
+#: (a real range id is always ``<begin>-<end>``, so it can never collide)
+RANGE_OTHER = "other"
+
+
+def split_range_series(name: str) -> tuple[str, str] | None:
+    """``range.<id>.<metric>`` -> ``(<id>, <metric>)``; None for any
+    other series name (the id itself never contains a dot)."""
+    if not name.startswith(RANGE_PREFIX):
+        return None
+    rest = name[len(RANGE_PREFIX):]
+    rid, dot, metric = rest.partition(".")
+    if not dot or not rid or not metric:
+        return None
+    return rid, metric
+
+
+class RangeScope:
+    """Booking facade for one key range's traffic matrix: push/pull
+    counts, bytes, apply cost and realized data age, all landing in the
+    shared ``wire_counters``/``latency_histograms`` under this range's
+    name prefix (see RANGE_PREFIX). One instance per ShardServer (its
+    owned range) and per serving handle (the range it proxies) — both
+    sides contribute to the SAME series, which is exactly right: a
+    cached client serve is a serve of that range's data, and
+    merge_telemetry unions the contributions cluster-wide."""
+
+    __slots__ = (
+        "rid", "_c_pull", "_c_pull_bytes", "_c_push", "_c_push_bytes",
+        "_h_apply", "_h_age",
+    )
+
+    def __init__(self, begin: int, end: int) -> None:
+        self.rid = f"{int(begin)}-{int(end)}"
+        p = RANGE_PREFIX + self.rid + "."
+        self._c_pull = p + "pull"
+        self._c_pull_bytes = p + "pull_bytes"
+        self._c_push = p + "push"
+        self._c_push_bytes = p + "push_bytes"
+        self._h_apply = p + "apply"
+        self._h_age = p + "age"
+
+    def pull(self, nbytes: int = 0) -> None:
+        wire_counters.inc(self._c_pull)
+        if nbytes:
+            wire_counters.inc(self._c_pull_bytes, int(nbytes))
+
+    def push(self, n: int = 1, nbytes: int = 0) -> None:
+        if n:
+            wire_counters.inc(self._c_push, int(n))
+        if nbytes:
+            wire_counters.inc(self._c_push_bytes, int(nbytes))
+
+    def apply(self, seconds: float) -> None:
+        latency_histograms.observe(self._h_apply, seconds)
+
+    def age(self, age_s: float) -> None:
+        latency_histograms.observe(self._h_age, max(age_s, 0.0))
+
+
+def known_ranges(telemetry: dict[str, Any]) -> list[tuple[int, int]]:
+    """The distinct key ranges present in a telemetry block's
+    ``range.<begin>-<end>.*`` series names, sorted by begin. The rid
+    string IS the range boundary, so the shard layout is recoverable
+    from the metrics alone — no side channel to the coordinator's
+    config, and a merged cluster block yields the cluster layout."""
+    rids: set[str] = set()
+    for blk in ("counters", "hists"):
+        for name in (telemetry.get(blk) or {}):
+            parsed = split_range_series(name)
+            if parsed and parsed[0] != RANGE_OTHER:
+                rids.add(parsed[0])
+    out: list[tuple[int, int]] = []
+    for rid in rids:
+        b, dash, e = rid.partition("-")
+        if dash and b.isdigit() and e.isdigit():
+            out.append((int(b), int(e)))
+    return sorted(out)
+
+
+def owning_range(
+    key: int, ranges: list[tuple[int, int]]
+) -> tuple[int, tuple[int, int]] | None:
+    """``(server rank, (begin, end))`` owning global ``key`` — ranks
+    follow sorted-range order, the ``even_divide`` assignment every
+    backend uses; None when no known range covers the key."""
+    for i, (b, e) in enumerate(ranges):
+        if b <= key < e:
+            return i, (b, e)
+    return None
+
+
 class Timer:
     """tic/toc accumulator (ref: util/resource_usage.h).
 
@@ -771,8 +873,17 @@ def format_cluster_stats(rep: dict[str, Any]) -> str:
             f"hot keys (count-min heat, {heat.get('n', 0)} accesses "
             "counted, top 10):"
         )
+        # freshness plane (ISSUE 17): place each hot key on the shard
+        # map — the owning range/rank comes straight from the merged
+        # range.<begin>-<end>.* series names, no extra plumbing
+        ranges = known_ranges(merged)
         for key, c in heat_top(heat, 10):
-            lines.append(f"  key {key:<24} ~{c}")
+            own = owning_range(int(key), ranges)
+            loc = (
+                f"  [range {own[1][0]}-{own[1][1]} @ server {own[0]}]"
+                if own else ""
+            )
+            lines.append(f"  key {key:<24} ~{c}{loc}")
     lines.append("")
     lines.append("per-command latency (merged across nodes):")
     lines.append(format_latency_table(merged.get("hists", {})))

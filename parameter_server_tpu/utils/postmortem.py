@@ -57,11 +57,13 @@ _PREFIX = "blackbox-"
 _CONTEXT_EVENTS = frozenset({
     "apply.begin",       # multislice: batch entered the apply engine
     "coord.dead_worker", # coordinator sweep promoted a dead worker
+    "freshness.serve",   # client serve booked a realized data age
     "heartbeat.beat",    # reporter liveness tick
     "mesh.apply",        # mesh backend: sharded update dispatched
     "mesh.pull",         # mesh backend: gather+psum pull issued
     "mesh.push",         # mesh backend: push payload (bytes post-quant)
     "prof.dump",         # continuous profiler wrote its exports
+    "range.roll",        # beat guard rolled the per-range matrix
     "rpc.conn_died",     # wire: connection death observed
     "rpc.issue",         # client issue side of the (cid, seq) stitch
     "rpc.out",           # frame left the process

@@ -42,6 +42,8 @@ from parameter_server_tpu.utils import flightrec
 from parameter_server_tpu.utils.metrics import (
     heat_top,
     hist_percentile,
+    owning_range,
+    split_range_series,
     wire_counters,
 )
 from parameter_server_tpu.utils.timeseries import TimeSeriesRing, series_scale
@@ -288,7 +290,7 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
         "",
         f"{'node':>5} {'role':<10} {'rank':>4} {'push/s':>9} "
         f"{'pull/s':>9} {'shed/s':>8} {'p99_push':>9} {'q_p99':>7} "
-        f"{'health':>7} {'audit':>6}  alerts",
+        f"{'age_p99':>8} {'health':>7} {'audit':>6}  alerts",
     ]
     def _row(nid: str, role: str, rank: str) -> str:
         s = series.get(nid) or {}
@@ -303,6 +305,9 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
         shed_rate = rates.get("serve_shed", 0.0)
         p99_push = _first(p99, "server.push", "client.push")
         q_p99 = p99.get("server.apply_queue.n", 0.0)
+        # realized data age of this node's serves (ms) — the freshness
+        # plane's headline number (ISSUE 17)
+        age_p99 = p99.get("serve.age", 0.0)
         burning = ",".join(h.get("burning") or []) or "-"
         score = h.get("score")
         # the audit column: violations attributed to this node's event
@@ -314,7 +319,7 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
             f"{nid:>5} {role:<10} "
             f"{rank:>4} {push_rate:>9.1f} "
             f"{pull_rate:>9.1f} {shed_rate:>8.1f} {p99_push:>9.2f} "
-            f"{q_p99:>7.0f} "
+            f"{q_p99:>7.0f} {age_p99:>8.1f} "
             f"{(str(score) if score is not None else '-'):>7} "
             f"{audit_cell:>6}  {burning}"
         )
@@ -374,6 +379,26 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
             + (f"  {parts}" if parts else "")
             + (f"  tid={worst['tid']}" if worst.get("tid") else "")
         )
+    # the freshness line (ISSUE 17): the window's stalest serve — the
+    # worst realized data-age p99 across nodes, and the key range the
+    # staleness concentrates in (`cli ranges` is the deep dive)
+    stalest: tuple[str | None, float] = (None, 0.0)
+    stale_rng: tuple[str | None, float] = (None, 0.0)
+    for nid, s in series.items():
+        for name, v in ((s or {}).get("p99") or {}).items():
+            if name == "serve.age" and v > stalest[1]:
+                stalest = (nid, v)
+            parsed = split_range_series(name)
+            if parsed and parsed[1] == "age" and v > stale_rng[1]:
+                stale_rng = (parsed[0], v)
+    if stalest[0] is not None or stale_rng[0] is not None:
+        bits = []
+        if stalest[0] is not None:
+            bits.append(f"node={stalest[0]} age_p99={stalest[1]}ms")
+        if stale_rng[0] is not None:
+            bits.append(f"range={stale_rng[0]} age_p99={stale_rng[1]}ms")
+        lines.append("")
+        lines.append("stalest serve: " + "  ".join(bits))
     heat = (rep.get("merged") or {}).get("key_heat")
     if heat:
         pairs = heat_top(heat, 5)
@@ -390,6 +415,93 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
         for p in prof[:3]:
             tail = ";".join(str(p.get("s", "")).split(";")[-3:])
             lines.append(f"  {p.get('n', 0):>6}  ...{tail}")
+    return "\n".join(lines)
+
+
+def ranges_view(rep: dict[str, Any], window_s: float) -> dict[str, Any]:
+    """Aggregate a coordinator ``telemetry`` reply's per-node windowed
+    series into ONE per-range traffic/freshness matrix (the `cli
+    ranges` data model, also its ``--json`` document): rates sum across
+    nodes (each node books its own contribution to a range's series);
+    percentiles take the cross-node MAX (a summary carries no buckets
+    to merge, and the worst node's p99 is the honest bound a dashboard
+    wants); hot-key heat folds the merged count-min sketch onto the
+    owning range."""
+    series: dict[str, Any] = rep.get("series") or {}
+    ranges: dict[str, dict[str, float]] = {}
+    for s in series.values():
+        s = s or {}
+        for blk in ("rates", "hist_rates"):
+            for name, v in (s.get(blk) or {}).items():
+                parsed = split_range_series(name)
+                if parsed is None:
+                    continue
+                d = ranges.setdefault(parsed[0], {})
+                key = parsed[1] + "_rate"
+                d[key] = round(d.get(key, 0.0) + float(v), 3)
+        for blk in ("p50", "p99"):
+            for name, v in (s.get(blk) or {}).items():
+                parsed = split_range_series(name)
+                if parsed is None:
+                    continue
+                d = ranges.setdefault(parsed[0], {})
+                key = f"{parsed[1]}_{blk}_ms"
+                d[key] = max(d.get(key, 0.0), float(v))
+    rngs: list[tuple[int, int]] = []
+    for rid in ranges:
+        b, dash, e = rid.partition("-")
+        if dash and b.isdigit() and e.isdigit():
+            rngs.append((int(b), int(e)))
+    rngs.sort()
+    heat = (rep.get("merged") or {}).get("key_heat")
+    if heat and rngs:
+        for key, c in heat_top(heat, 32):
+            own = owning_range(int(key), rngs)
+            if own:
+                rid = f"{own[1][0]}-{own[1][1]}"
+                d = ranges.setdefault(rid, {})
+                d["heat"] = d.get("heat", 0) + int(c)
+    return {"window_s": window_s, "ranges": ranges}
+
+
+def format_ranges(rep: dict[str, Any], window_s: float) -> str:
+    """Render one ``cli ranges`` frame: the per-range matrix — push/pull
+    rates, bytes moved, apply cost and the realized data-age
+    distribution of serves touching each range — from a coordinator
+    ``telemetry`` reply."""
+    view = ranges_view(rep, window_s)
+    ranges: dict[str, dict[str, float]] = view["ranges"]
+    lines = [
+        f"ps ranges — {len(ranges)} range(s), window {window_s:.0f}s, "
+        f"{time.strftime('%H:%M:%S')}",
+        "",
+        f"{'range':<16} {'pull/s':>9} {'push/s':>9} {'out_B/s':>11} "
+        f"{'in_B/s':>11} {'apply_p99':>10} {'age_p50':>9} {'age_p99':>9} "
+        f"{'heat':>7}",
+    ]
+
+    def _rid_key(rid: str) -> tuple:
+        b, _, _ = rid.partition("-")
+        # numeric ranges in key order; the saturation fold ("other") and
+        # anything unparsable sorts last
+        return (0, int(b), rid) if b.isdigit() else (1, 0, rid)
+
+    for rid in sorted(ranges, key=_rid_key):
+        d = ranges[rid]
+        lines.append(
+            f"{rid:<16} {d.get('pull_rate', 0.0):>9.1f} "
+            f"{d.get('push_rate', 0.0):>9.1f} "
+            f"{d.get('pull_bytes_rate', 0.0):>11.0f} "
+            f"{d.get('push_bytes_rate', 0.0):>11.0f} "
+            f"{d.get('apply_p99_ms', 0.0):>10.2f} "
+            f"{d.get('age_p50_ms', 0.0):>9.1f} "
+            f"{d.get('age_p99_ms', 0.0):>9.1f} "
+            f"{int(d.get('heat', 0)):>7}"
+        )
+    if not ranges:
+        lines.append(
+            "(no range series in the window — freshness plane idle)"
+        )
     return "\n".join(lines)
 
 

@@ -50,8 +50,11 @@ from typing import Any, Callable
 from parameter_server_tpu.utils import flightrec
 from parameter_server_tpu.utils.metrics import (
     _HIST_BUCKETS,
+    RANGE_OTHER,
+    RANGE_PREFIX,
     hist_percentile,
     merge_hist_snapshots,
+    split_range_series,
     telemetry_snapshot,
     wire_counters,
 )
@@ -71,6 +74,15 @@ BEAT_MAX_HISTS = 64
 #: holds even against a misconfigured profiler)
 BEAT_MAX_PROF = 8
 BEAT_MAX_STACK_CHARS = 1024
+#: freshness plane (ISSUE 17): at most this many DISTINCT key ranges may
+#: ride one beat's ``range.<id>.*`` matrix — a resharded or synthetic
+#: run with thousands of ranges collapses its cold tail into one
+#: ``range.other.*`` aggregate, so range cardinality can never blow up
+#: a heartbeat (the same discipline BEAT_MAX_HISTS applies to series
+#: count, applied one level up to the range axis specifically: without
+#: this, 10k ranges x 6 series each would saturate the hist guard and
+#: crowd every NON-range series out of the beat)
+BEAT_MAX_RANGES = 32
 
 
 def _counter_deltas(
@@ -337,14 +349,74 @@ class Roller:
 # -- heartbeat payload guard ------------------------------------------------
 
 
+def _saturate_ranges(
+    counters: dict[str, int], hists: dict[str, Any]
+) -> tuple[dict[str, int], dict[str, Any], int, int]:
+    """Bound the distinct key ranges in one telemetry block to
+    ``BEAT_MAX_RANGES``: the highest-traffic ranges keep their own
+    ``range.<id>.*`` series, the tail folds into summed
+    ``range.other.*`` counters and bucket-merged histograms (percentiles
+    over the folded tail stay exact — the PR-2 merge discipline).
+    Returns ``(counters, hists, n_ranges, n_folded)``."""
+    traffic: dict[str, int] = {}
+    for name, v in counters.items():
+        parsed = split_range_series(name)
+        if parsed and parsed[0] != RANGE_OTHER:
+            traffic[parsed[0]] = traffic.get(parsed[0], 0) + int(v)
+    for name, s in hists.items():
+        parsed = split_range_series(name)
+        if parsed and parsed[0] != RANGE_OTHER:
+            traffic[parsed[0]] = traffic.get(parsed[0], 0) + int(
+                s.get("count", 0)
+            )
+    n = len(traffic)
+    if n <= BEAT_MAX_RANGES:
+        return counters, hists, n, 0
+    keep = set(
+        sorted(traffic, key=lambda r: (-traffic[r], r))[:BEAT_MAX_RANGES]
+    )
+    c_out: dict[str, int] = {}
+    for name, v in counters.items():
+        parsed = split_range_series(name)
+        if parsed is None or parsed[0] in keep:
+            c_out[name] = v
+        else:
+            oname = RANGE_PREFIX + RANGE_OTHER + "." + parsed[1]
+            c_out[oname] = c_out.get(oname, 0) + int(v)
+    h_out: dict[str, Any] = {}
+    folded: dict[str, list] = {}
+    for name, s in hists.items():
+        parsed = split_range_series(name)
+        if parsed is None or parsed[0] in keep:
+            h_out[name] = s
+        else:
+            folded.setdefault(parsed[1], []).append(s)
+    for metric, snaps in folded.items():
+        oname = RANGE_PREFIX + RANGE_OTHER + "." + metric
+        if oname in h_out:  # an upstream fold already contributed
+            snaps = snaps + [h_out[oname]]
+        h_out[oname] = merge_hist_snapshots(snaps)
+    return c_out, h_out, n, n - BEAT_MAX_RANGES
+
+
 def beat_telemetry(snap: dict[str, Any] | None = None) -> dict[str, Any]:
     """The bounded beat payload: the cumulative snapshot with its
-    histogram and profiler blocks saturated to summaries past the
-    per-beat budget. Also rolls the local ring (one snapshot serves the
-    beat, the ring and the guard)."""
+    range matrix, histogram and profiler blocks saturated to summaries
+    past the per-beat budget. Also rolls the local ring (one snapshot
+    serves the beat, the ring and the guard)."""
     snap = local_roll(snap)
     out = dict(snap)
-    hists = snap.get("hists") or {}
+    counters, hists, n_ranges, folded = _saturate_ranges(
+        dict(snap.get("counters") or {}), dict(snap.get("hists") or {})
+    )
+    out["counters"] = counters
+    if n_ranges:
+        if folded:
+            out["ranges_saturated"] = folded
+            # always-rendered OpenMetrics saturation counter: a scraper
+            # can tell "tail folded into range=other" from "few ranges"
+            wire_counters.inc("range_label_saturated", folded)
+        flightrec.record("range.roll", ranges=n_ranges, folded=folded)
     if len(hists) > BEAT_MAX_HISTS:
         # keep the heaviest series whole; the tail collapses into ONE
         # count/sum-only summary so the beat can never grow unboundedly
@@ -359,8 +431,9 @@ def beat_telemetry(snap: dict[str, Any] | None = None) -> dict[str, Any]:
             "sum_s": sum(s.get("sum_s", 0.0) for _, s in dropped),
             "buckets": {},
         }
-        out["hists"] = kept
+        hists = kept
         out["hists_saturated"] = len(dropped)
+    out["hists"] = hists
     prof = snap.get("prof")
     if prof:
         out["prof"] = [
@@ -405,6 +478,121 @@ def build_info(proc: str = "") -> dict[str, str]:
     }
 
 
+#: hard cap on distinct ``range="<id>"`` label values per scrape — a
+#: Prometheus time-series database pays per label combination forever,
+#: so the exposition folds the cold tail into ``range="other"`` rather
+#: than letting reshards mint unbounded series (the classic cardinality
+#: explosion). Tighter than BEAT_MAX_RANGES: a scrape is an external,
+#: durable sink; a beat is internal and windowed.
+OM_MAX_RANGE_LABELS = 16
+
+
+def _label_set(*parts: str) -> str:
+    """``{a="1",b="2"}`` from the non-empty parts ('' when none)."""
+    body = ",".join(p for p in parts if p)
+    return "{" + body + "}" if body else ""
+
+
+def _fold_render_ranges(
+    counters: dict[str, Any], hists: dict[str, Any]
+) -> tuple[dict[str, dict], dict[str, dict], int]:
+    """Pull every ``range.<id>.<metric>`` series OUT of the two blocks
+    (mutating them) into per-metric ``{rid: value}`` / ``{rid: hist}``
+    maps for labeled rendering, keeping only the ``OM_MAX_RANGE_LABELS``
+    highest-traffic ids distinct — the rest (including any upstream
+    ``other`` fold riding the snapshot) merge into ``rid="other"``.
+    Returns ``(range_counters, range_hists, n_folded)``."""
+    traffic: dict[str, int] = {}
+    rc: dict[str, dict] = {}
+    rh: dict[str, dict] = {}
+    for name in list(counters):
+        parsed = split_range_series(name)
+        if parsed is None:
+            continue
+        rid, metric = parsed
+        v = counters.pop(name)
+        rc.setdefault(metric, {})[rid] = v
+        if rid != RANGE_OTHER:
+            traffic[rid] = traffic.get(rid, 0) + int(v)
+    for name in list(hists):
+        parsed = split_range_series(name)
+        if parsed is None:
+            continue
+        rid, metric = parsed
+        s = hists.pop(name)
+        rh.setdefault(metric, {})[rid] = s
+        if rid != RANGE_OTHER:
+            traffic[rid] = traffic.get(rid, 0) + int(s.get("count", 0))
+    if len(traffic) <= OM_MAX_RANGE_LABELS:
+        return rc, rh, 0
+    keep = set(
+        sorted(traffic, key=lambda r: (-traffic[r], r))[:OM_MAX_RANGE_LABELS]
+    )
+    for metric, by_rid in rc.items():
+        out: dict[str, Any] = {}
+        for rid, v in by_rid.items():
+            if rid in keep:
+                out[rid] = v
+            else:
+                out[RANGE_OTHER] = out.get(RANGE_OTHER, 0) + int(v)
+        rc[metric] = out
+    for metric, by_rid in rh.items():
+        out = {}
+        fold: list[dict] = []
+        for rid, s in by_rid.items():
+            if rid in keep:
+                out[rid] = s
+            else:
+                fold.append(s)
+        if fold:
+            out[RANGE_OTHER] = merge_hist_snapshots(fold)
+        rh[metric] = out
+    return rc, rh, len(traffic) - OM_MAX_RANGE_LABELS
+
+
+def _render_hist(
+    lines: list[str], m: str, s: dict[str, Any], base: str,
+    count_valued: bool,
+) -> None:
+    """One histogram exposition (cumulative ``le`` buckets, sum, count)
+    under label body ``base`` (e.g. ``proc="w-0",range="0-64"``)."""
+    buckets = {int(k): int(v) for k, v in s.get("buckets", {}).items()}
+    # tail-trace exemplar (ISSUE 15): the window's max-latency
+    # observation carries its trace id — rendered with the
+    # OpenMetrics exemplar syntax on the bucket containing it, so a
+    # dashboard's p99 spike links straight to the retained trace
+    ex = s.get("ex") or {}
+    ex_sfx = ""
+    ex_bucket = -1
+    if ex.get("tid") and not count_valued:
+        v = float(ex.get("v", 0.0))
+        ex_bucket = min(int(v * 1e6).bit_length(), _HIST_TOP_BUCKET)
+        ex_ts = ex.get("ts")
+        ex_sfx = (
+            f' # {{trace_id="{ex["tid"]}"}} {_fmt(v)}'
+            + (f" {_fmt(float(ex_ts))}" if ex_ts else "")
+        )
+    cum = 0
+    for i in sorted(buckets):
+        cum += buckets[i]
+        edge = float(1 << i) if count_valued else (1 << i) / 1e6
+        lab = _label_set(base, f'le="{_fmt(edge)}"')
+        sfx = ex_sfx if i == ex_bucket else ""
+        if sfx:
+            ex_sfx = ""  # attach exactly once
+        lines.append(f"{m}_bucket{lab} {cum}{sfx}")
+    inf_lab = _label_set(base, 'le="+Inf"')
+    # an exemplar whose bucket is absent (merged/rolled snapshots)
+    # attaches to +Inf — an exemplar must never be silently lost
+    lines.append(f"{m}_bucket{inf_lab} {s.get('count', 0)}{ex_sfx}")
+    total = s.get("sum_s", 0.0)
+    if count_valued:
+        total *= 1e6  # decode the as-if-microseconds value encoding
+    blab = _label_set(base)
+    lines.append(f"{m}_sum{blab} {_fmt(float(total))}")
+    lines.append(f"{m}_count{blab} {s.get('count', 0)}")
+
+
 def render_openmetrics(
     snap: dict[str, Any], proc: str = ""
 ) -> str:
@@ -414,13 +602,23 @@ def render_openmetrics(
     seconds; ``.n`` count series in raw values), timers as two counters,
     ``# EOF`` terminator.
 
-    Two series are emitted UNCONDITIONALLY (the tier-1 format validator
-    requires them): ``ps_build_info`` (the Prometheus info-metric idiom
-    — constant 1 with version/role/rank labels, what dashboards join
-    on) and ``ps_audit_violations_total`` (ISSUE 14: a clean cluster
-    scrapes an explicit 0, so "no violations" and "audit plane absent"
-    are different observations)."""
-    label = f'{{proc="{proc}"}}' if proc else ""
+    The freshness plane's ``range.<id>.<metric>`` series render as
+    LABELED families instead of one metric name per range —
+    ``ps_range_pull_total{range="0-64"}``,
+    ``ps_range_age_seconds_bucket{range="0-64",le=...}`` — capped at
+    ``OM_MAX_RANGE_LABELS`` distinct ids (tail folds to
+    ``range="other"``) so a reshard can never mint unbounded label
+    cardinality into a scraper's TSDB.
+
+    Three series are emitted UNCONDITIONALLY (the tier-1 format
+    validator requires them): ``ps_build_info`` (the Prometheus
+    info-metric idiom — constant 1 with version/role/rank labels, what
+    dashboards join on), ``ps_audit_violations_total`` (ISSUE 14) and
+    ``ps_range_label_saturated_total`` (ISSUE 17) — a clean cluster
+    scrapes explicit 0s, so "nothing fired/folded" and "plane absent"
+    are different observations."""
+    plabel = f'proc="{proc}"' if proc else ""
+    label = _label_set(plabel)
     lines: list[str] = []
     info = build_info(proc)
     info_labels = ",".join(
@@ -433,6 +631,10 @@ def render_openmetrics(
     counters = dict(snap.get("counters") or {})
     # always-present audit verdict counter (0 until a violation fires)
     counters.setdefault("audit_violations", 0)
+    # ... and the range-label saturation counter (0 until a fold)
+    counters.setdefault("range_label_saturated", 0)
+    hists = dict(snap.get("hists") or {})
+    range_c, range_h, _folded = _fold_render_ranges(counters, hists)
     for name in sorted(counters):
         v = counters[name]
         m = _metric_name(name)
@@ -442,48 +644,31 @@ def render_openmetrics(
         else:
             lines.append(f"# TYPE {m} counter")
             lines.append(f"{m}_total{label} {_fmt(v)}")
-    for name in sorted(snap.get("hists") or {}):
-        s = snap["hists"][name]
+    for metric in sorted(range_c):
+        m = _metric_name("range_" + metric)
+        lines.append(f"# TYPE {m} counter")
+        for rid in sorted(range_c[metric]):
+            lab = _label_set(plabel, f'range="{rid}"')
+            lines.append(f"{m}_total{lab} {_fmt(range_c[metric][rid])}")
+    for name in sorted(hists):
+        s = hists[name]
         count_valued = name.endswith(".n")
         m = _metric_name(name if count_valued else name + "_seconds")
         lines.append(f"# TYPE {m} histogram")
-        buckets = {int(k): int(v) for k, v in s.get("buckets", {}).items()}
-        # tail-trace exemplar (ISSUE 15): the window's max-latency
-        # observation carries its trace id — rendered with the
-        # OpenMetrics exemplar syntax on the bucket containing it, so a
-        # dashboard's p99 spike links straight to the retained trace
-        ex = s.get("ex") or {}
-        ex_sfx = ""
-        ex_bucket = -1
-        if ex.get("tid") and not count_valued:
-            v = float(ex.get("v", 0.0))
-            ex_bucket = min(int(v * 1e6).bit_length(), _HIST_TOP_BUCKET)
-            ex_ts = ex.get("ts")
-            ex_sfx = (
-                f' # {{trace_id="{ex["tid"]}"}} {_fmt(v)}'
-                + (f" {_fmt(float(ex_ts))}" if ex_ts else "")
-            )
-        cum = 0
-        for i in sorted(buckets):
-            cum += buckets[i]
-            edge = float(1 << i) if count_valued else (1 << i) / 1e6
-            le = f'le="{_fmt(edge)}"'
-            lab = f'{{proc="{proc}",{le}}}' if proc else f"{{{le}}}"
-            sfx = ex_sfx if i == ex_bucket else ""
-            if sfx:
-                ex_sfx = ""  # attach exactly once
-            lines.append(f"{m}_bucket{lab} {cum}{sfx}")
-        inf_lab = (
-            f'{{proc="{proc}",le="+Inf"}}' if proc else '{le="+Inf"}'
+        _render_hist(lines, m, s, plabel, count_valued)
+    for metric in sorted(range_h):
+        count_valued = metric.endswith(".n")
+        m = _metric_name(
+            "range_" + (metric if count_valued else metric + "_seconds")
         )
-        # an exemplar whose bucket is absent (merged/rolled snapshots)
-        # attaches to +Inf — an exemplar must never be silently lost
-        lines.append(f"{m}_bucket{inf_lab} {s.get('count', 0)}{ex_sfx}")
-        total = s.get("sum_s", 0.0)
-        if count_valued:
-            total *= 1e6  # decode the as-if-microseconds value encoding
-        lines.append(f"{m}_sum{label} {_fmt(float(total))}")
-        lines.append(f"{m}_count{label} {s.get('count', 0)}")
+        lines.append(f"# TYPE {m} histogram")
+        for rid in sorted(range_h[metric]):
+            base = ",".join(
+                p for p in (plabel, f'range="{rid}"') if p
+            )
+            _render_hist(
+                lines, m, range_h[metric][rid], base, count_valued
+            )
     for name in sorted(snap.get("timers") or {}):
         t = snap["timers"][name]
         m = _metric_name("timer_" + name)

@@ -662,7 +662,9 @@ class TestLiveAuditDrill:
             row = next(
                 ln for ln in out.splitlines() if " worker " in ln
             )
-            assert row.split()[9] == "2"  # the audit column
+            # col 8 is the freshness age_p99 (ISSUE 17), 9 the health
+            # score; the audit column sits at 10
+            assert row.split()[10] == "2"  # the audit column
         finally:
             child.kill()
             child.wait(timeout=10)

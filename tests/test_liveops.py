@@ -122,13 +122,17 @@ def validate_openmetrics(text: str) -> dict[str, str]:
     """Minimal OpenMetrics validator: returns {family: type}. Asserts
     the EOF terminator, name grammar, counter ``_total`` suffixes,
     histogram bucket coherence (cumulative, +Inf == count), the
-    ISSUE 14 always-present series — ``ps_build_info`` (info-metric
-    gauge with version/role/rank labels) and
-    ``ps_audit_violations_total`` (explicit 0 on a clean node, so "no
-    violations" and "audit plane absent" scrape differently) — and
-    (ISSUE 15) the exemplar syntax: ``# {labels} value [ts]`` suffixes
-    are accepted ONLY on histogram ``_bucket`` samples and must carry a
-    well-formed label set and a parseable value."""
+    ISSUE 14/17 always-present series — ``ps_build_info`` (info-metric
+    gauge with version/role/rank labels), ``ps_audit_violations_total``
+    and ``ps_range_label_saturated_total`` (explicit 0s on a clean
+    node, so "nothing fired/folded" and "plane absent" scrape
+    differently) — and (ISSUE 15) the exemplar syntax: ``# {labels}
+    value [ts]`` suffixes are accepted ONLY on histogram ``_bucket``
+    samples and must carry a well-formed label set and a parseable
+    value. Histogram coherence is checked PER LABEL SET (minus ``le``):
+    a labeled family — the freshness plane's ``range="..."`` series —
+    exposes one independent cumulative bucket ladder per label
+    combination, and mixing them would fake non-cumulative buckets."""
     lines = text.splitlines()
     assert lines, "empty exposition"
     assert lines[-1] == "# EOF", "must end with the EOF terminator"
@@ -180,6 +184,12 @@ def validate_openmetrics(text: str) -> dict[str, str]:
                 f"counter sample must use _total: {name}"
             )
             assert value >= 0
+    def _minus_le(labels: str) -> str:
+        body = labels[1:-1] if labels else ""
+        return ",".join(
+            p for p in body.split(",") if p and not p.startswith('le="')
+        )
+
     for fam, typ in types.items():
         if typ != "histogram":
             continue
@@ -187,20 +197,30 @@ def validate_openmetrics(text: str) -> dict[str, str]:
             (labels, v) for n, labels, v in samples if n == fam + "_bucket"
         ]
         assert buckets, f"histogram {fam} has no buckets"
-        les = []
+        by_group: dict[str, list[tuple[float, float]]] = {}
         for labels, v in buckets:
             m = re.search(r'le="([^"]+)"', labels)
             assert m, f"bucket without le: {fam} {labels}"
-            les.append((
+            by_group.setdefault(_minus_le(labels), []).append((
                 float("inf") if m[1] == "+Inf" else float(m[1]), v,
             ))
-        les.sort(key=lambda x: x[0])
-        assert les[-1][0] == float("inf"), f"{fam} missing +Inf bucket"
-        counts = [v for _, v in les]
-        assert counts == sorted(counts), f"{fam} buckets not cumulative"
-        total = next(v for n, _, v in samples if n == fam + "_count")
-        assert les[-1][1] == total, f"{fam} +Inf bucket != count"
-    # the always-present series (ISSUE 14 satellite)
+        for group, les in by_group.items():
+            les.sort(key=lambda x: x[0])
+            assert les[-1][0] == float("inf"), (
+                f"{fam}{{{group}}} missing +Inf bucket"
+            )
+            counts = [v for _, v in les]
+            assert counts == sorted(counts), (
+                f"{fam}{{{group}}} buckets not cumulative"
+            )
+            total = next(
+                v for n, labels, v in samples
+                if n == fam + "_count" and _minus_le(labels) == group
+            )
+            assert les[-1][1] == total, (
+                f"{fam}{{{group}}} +Inf bucket != count"
+            )
+    # the always-present series (ISSUE 14/17 satellites)
     assert types.get("ps_build_info") == "gauge"
     info = next(
         (labels, v) for n, labels, v in samples if n == "ps_build_info"
@@ -209,6 +229,10 @@ def validate_openmetrics(text: str) -> dict[str, str]:
     assert info[1] == 1.0
     assert types.get("ps_audit_violations") == "counter"
     assert any(n == "ps_audit_violations_total" for n, _, _ in samples)
+    assert types.get("ps_range_label_saturated") == "counter"
+    assert any(
+        n == "ps_range_label_saturated_total" for n, _, _ in samples
+    )
     return types
 
 
@@ -636,7 +660,9 @@ class TestLiveCluster:
             cols = row.split()
             push_rate, p99_push = float(cols[3]), float(cols[6])
             assert push_rate > 0 and p99_push > 0
-            assert cols[8] == "100"  # healthy node scores 100
+            # col 8 is the freshness plane's age_p99 (ISSUE 17); a
+            # training-only worker serves nothing, so it reads 0.0
+            assert cols[9] == "100"  # healthy node scores 100
         finally:
             child.kill()
             child.wait(timeout=10)
