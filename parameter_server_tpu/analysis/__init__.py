@@ -37,6 +37,20 @@ invariants PRs 1-4 introduced:
     flightrec-contract   every flightrec.record() event is known to the
                          postmortem plane, and every stitched/flagged
                          event name is actually emitted
+    units                dimension lattice (us/ms/s/bytes/count/clocks)
+                         inferred from name suffixes + literal factor
+                         conversions; cross-unit arithmetic/comparison
+                         and unit-mismatched sinks are findings
+                         (dataflow-backed: analysis/quantity.py)
+    clockdomain          timestamps tagged by source clock (wall/mono/
+                         perf_counter/peer-echoed foreign-wall); mixing
+                         domains in -, <, min/max outside a declared
+                         skew clamp is a finding (dataflow-backed)
+    idtype               opaque identities (cid/seq/rank/ver/key/trace)
+                         are their own types: cross-space comparison,
+                         arithmetic on opaque ids (ver equality-only),
+                         and call-boundary id swaps are findings
+                         (dataflow-backed)
 
 Suppressions: ``# psl: ignore[<checker>]: <why>`` at the flagged line;
 tree policy in pyproject.toml ``[tool.pslint]``. The runtime complements:
@@ -83,6 +97,11 @@ from parameter_server_tpu.analysis.lockgraph import (
     build_lock_graph,
     check_lock_order,
 )
+from parameter_server_tpu.analysis.quantity import (
+    check_clockdomain,
+    check_idtype,
+    check_units,
+)
 from parameter_server_tpu.analysis.rcu import check_rcu
 from parameter_server_tpu.analysis.replycache import check_replycache_contract
 from parameter_server_tpu.analysis.settle import check_settle_exactly_once
@@ -127,6 +146,11 @@ CHECKERS: dict[str, Checker] = {
     "spec-conformance": check_spec_conformance,
     "model-invariants": check_model_invariants,
     "flightrec-contract": check_flightrec_contract,
+    # ISSUE 20 (pslint v3): quantity-flow triple over the shared
+    # dataflow fixpoint (analysis/flowrun.py)
+    "units": check_units,
+    "clockdomain": check_clockdomain,
+    "idtype": check_idtype,
 }
 
 #: checkers whose findings default to "warn" severity (exit 2, not 1)

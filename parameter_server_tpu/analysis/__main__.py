@@ -13,12 +13,22 @@ match on (checker, file, message) — deliberately line-insensitive, so
 edits above a finding don't churn the gate — and are counted as a
 multiset, so introducing a SECOND instance of an already-baselined
 finding still fails. ``--update-baseline`` rewrites the file from the
-current findings (the reviewed way to accept or retire debt)."""
+current findings (the reviewed way to accept or retire debt).
+
+``--changed-only REF`` (pslint v3) narrows the REPORT to files that
+differ from the git ref (diff + untracked): the ANALYSIS still runs
+over the whole package — interprocedural summaries and the shared
+dataflow fixpoint need every file — so a change whose finding
+surfaces in an unchanged file is the one case the filter can hide,
+and the full run stays the gate of record. When git is unavailable
+the filter fails OPEN (everything reports): a silently empty lint
+must never read as clean."""
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from collections import Counter
 from pathlib import Path
@@ -75,6 +85,39 @@ def new_vs_baseline(
     return out
 
 
+def changed_files(ref: str, root: Path) -> set[str] | None:
+    """Package-relative paths of files changed vs ``ref`` (worktree
+    diff, staged included, plus untracked); None when git can't answer
+    — the caller must then skip filtering (fail open)."""
+    root = root.resolve()
+
+    def _git(*args: str) -> str:
+        return subprocess.run(
+            ["git", "-C", str(root), *args],
+            capture_output=True, text=True, check=True, timeout=30,
+        ).stdout
+
+    try:
+        top = Path(_git("rev-parse", "--show-toplevel").strip())
+        listed = (
+            _git("diff", "--name-only", ref, "--")
+            # --full-name: toplevel-relative, like diff --name-only
+            + _git("ls-files", "--others", "--exclude-standard",
+                   "--full-name")
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out: set[str] = set()
+    for line in listed.splitlines():
+        if not line.strip():
+            continue
+        try:
+            out.add((top / line).resolve().relative_to(root).as_posix())
+        except ValueError:
+            continue  # changed, but outside the analyzed package
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="pslint")
     p.add_argument(
@@ -103,9 +146,23 @@ def main(argv: list[str] | None = None) -> int:
         "--update-baseline", action="store_true",
         help="write the current findings to --baseline and exit 0",
     )
+    p.add_argument(
+        "--changed-only", default=None, metavar="REF",
+        help="report only findings in files changed vs this git ref "
+        "(diff + untracked); the analysis itself still covers the "
+        "whole package, so interprocedural summaries stay exact — "
+        "this narrows the REPORT for fast pre-push iteration, it is "
+        "not the gate of record. Fails open (reports everything) "
+        "when git can't answer",
+    )
     args = p.parse_args(argv)
     if args.update_baseline and not args.baseline:
         p.error("--update-baseline requires --baseline FILE")
+    if args.update_baseline and args.changed_only:
+        p.error(
+            "--update-baseline records the FULL package's findings; "
+            "drop --changed-only"
+        )
     checkers = CHECKERS
     if args.checker:
         unknown = sorted(set(args.checker) - set(CHECKERS))
@@ -114,6 +171,21 @@ def main(argv: list[str] | None = None) -> int:
         checkers = {n: CHECKERS[n] for n in args.checker}
     config = _default_config(Path(args.root))
     findings = analyze_package(args.root, checkers=checkers, config=config)
+    scope = ""
+    if args.changed_only:
+        changed = changed_files(args.changed_only, Path(args.root))
+        if changed is None:
+            print(
+                f"pslint: --changed-only {args.changed_only}: git "
+                "unavailable — reporting ALL findings",
+                file=sys.stderr,
+            )
+        else:
+            findings = [f for f in findings if f.path in changed]
+            scope = (
+                f" [changed-only vs {args.changed_only}: "
+                f"{len(changed)} file(s)]"
+            )
     if args.baseline and args.update_baseline:
         Path(args.baseline).write_text(json.dumps(
             {"findings": [finding_json(f, config) for f in findings]},
@@ -146,7 +218,7 @@ def main(argv: list[str] | None = None) -> int:
             f"pslint: {len(findings)} finding(s) "
             f"({len(errors)} error(s), {len(gated) - len(errors)} "
             f"warning(s) gating){suffix}, "
-            f"{len(checkers)} checker(s) over {args.root}"
+            f"{len(checkers)} checker(s) over {args.root}{scope}"
         )
     # tiered exit codes: errors are a hard 1, a warn-only run exits 2
     # (CI can gate on 1 while new analyses phase in), clean is 0

@@ -82,6 +82,25 @@ class PslintConfig:
     #: exit 1, warn-only runs exit 2) — how a new analysis phases in
     #: without invalidating an error-gating CI baseline workflow
     warn: list[str] = field(default_factory=list)
+    #: quantity-checker whitelists (ISSUE 20). Grammar (each entry one
+    #: string, whitespace-tolerant):
+    #:   unit-conversions:  "<fn_name> -> <unit>"  — a call to this
+    #:       function returns a value of <unit> (us|ms|s|bytes|count|
+    #:       clocks) whatever its arguments carry; this is how a
+    #:       blessed conversion crosses the dimension lattice without a
+    #:       finding (name-suffix inference covers helpers like
+    #:       ``now_wall_us`` already — list only the exceptions).
+    unit_conversions: list[str] = field(default_factory=list)
+    #:   clock-clamps: "<fn_name>" — a declared skew boundary: clock-
+    #:       domain mixing inside this function's body or anywhere in
+    #:       its call arguments is sanctioned (extends the built-in
+    #:       convention that any function whose name contains "clamp"
+    #:       is a skew boundary).
+    clock_clamps: list[str] = field(default_factory=list)
+    #:   clock-foreign-keys: "<header_key>" — a wire/header field whose
+    #:       value is a PEER's wall-clock timestamp (foreign-wall
+    #:       domain; extends the built-in {"pts"}).
+    clock_foreign_keys: list[str] = field(default_factory=list)
 
     @classmethod
     def load(cls, pyproject: Path | None) -> "PslintConfig":
@@ -95,6 +114,9 @@ class PslintConfig:
             exclude=list(sec.get("exclude", [])),
             disable=list(sec.get("disable", [])),
             warn=list(sec.get("warn", [])),
+            unit_conversions=list(sec.get("unit-conversions", [])),
+            clock_clamps=list(sec.get("clock-clamps", [])),
+            clock_foreign_keys=list(sec.get("clock-foreign-keys", [])),
         )
 
 
@@ -139,11 +161,25 @@ def _parse_pragmas(text: str) -> dict[int, Pragma]:
 
 
 class PackageIndex:
-    """Parsed view of every analyzed module, shared by all checkers."""
+    """Parsed view of every analyzed module, shared by all checkers.
 
-    def __init__(self, files: list[SourceFile], root: Path):
+    Every file is read and ``ast.parse``d exactly once, here — checkers
+    only ever walk the trees this index already holds. The index also
+    carries the :class:`PslintConfig` it was loaded under, because
+    checkers receive nothing but the index and the quantity checkers
+    (units/clockdomain/idtype) need the whitelist grammar from
+    ``[tool.pslint]``.
+    """
+
+    def __init__(
+        self,
+        files: list[SourceFile],
+        root: Path,
+        config: PslintConfig | None = None,
+    ):
         self.files = files
         self.root = root
+        self.config = config or PslintConfig()
         self._by_rel = {f.relpath: f for f in files}
 
     def get(self, relpath: str) -> SourceFile | None:
@@ -151,7 +187,10 @@ class PackageIndex:
 
     @classmethod
     def from_sources(
-        cls, sources: dict[str, str], root: Path | None = None
+        cls,
+        sources: dict[str, str],
+        root: Path | None = None,
+        config: PslintConfig | None = None,
     ) -> "PackageIndex":
         """In-memory index (tests: crafted positive/negative snippets)."""
         files = [
@@ -164,7 +203,7 @@ class PackageIndex:
             )
             for rel, src in sources.items()
         ]
-        return cls(files, root or Path("."))
+        return cls(files, root or Path("."), config)
 
 
 def load_package(
@@ -187,7 +226,7 @@ def load_package(
                 pragmas=_parse_pragmas(text),
             )
         )
-    return PackageIndex(files, root)
+    return PackageIndex(files, root, config)
 
 
 Checker = Callable[[PackageIndex], list[Finding]]

@@ -181,47 +181,47 @@ def ops_from_trace(events: list[dict[str, Any]]) -> list[dict[str, Any]]:
             e for e in evs
             if e.get("ph") == "f" and e["name"] == f"ps.{cmd}.inflight"
         ]
-        t0 = root["ts"]
-        done = max(
+        t0_us = root["ts"]
+        done_us = max(
             [f["ts"] for f in flows] + [_span_end(s) for s in spans]
         )
-        total_us = max(done - t0, 0.0)
+        total_us = max(done_us - t0_us, 0.0)
         seg_us: dict[str, float] = {}
         if rpc is not None:
-            seg_us["encode"] = _clamp(rpc["ts"] - t0, op)
+            seg_us["encode"] = _clamp(rpc["ts"] - t0_us, op)
             seg_us["client_queue"] = rpc.get("dur", 0.0)
-            issue_end = _span_end(rpc)
+            issue_end_us = _span_end(rpc)
         else:
-            issue_end = t0
+            issue_end_us = t0_us
         if serve is not None:
-            seg_us["wire"] = _clamp(serve["ts"] - issue_end, op)
+            seg_us["wire"] = _clamp(serve["ts"] - issue_end_us, op)
             seg_us["server"] = serve.get("dur", 0.0)
-            tail_start = _span_end(serve)
+            tail_start_us = _span_end(serve)
             # the apply segments exist only on the BATCHED path, where
             # the updater span runs on the apply thread after dispatch
             # returned; an updater span nested inside the serve span is
             # the inline path — its time is already in "server"
-            if upd is not None and upd["ts"] >= tail_start:
-                gap = _clamp(_span_end(upd) - tail_start, op)
+            if upd is not None and upd["ts"] >= tail_start_us:
+                gap_us = _clamp(_span_end(upd) - tail_start_us, op)
                 # the marker fires AFTER the apply with the MEASURED
                 # jitted-apply time in its args (multislice stamps
                 # apl_us) — a first-batch jit compile lands in "apply",
                 # not in the queue-wait column; the gap's remainder is
                 # the real apply_wait
-                apl = min(
+                apl_us = min(
                     float((upd.get("args") or {}).get(
                         "apl_us", upd.get("dur", 0.0)
                     )),
-                    gap,
+                    gap_us,
                 )
-                seg_us["apply_wait"] = gap - apl
-                seg_us["apply"] = apl
-                tail_start = max(tail_start, _span_end(upd))
-            seg_us["reply_lane"] = _clamp(done - tail_start, op)
+                seg_us["apply_wait"] = gap_us - apl_us
+                seg_us["apply"] = apl_us
+                tail_start_us = max(tail_start_us, _span_end(upd))
+            seg_us["reply_lane"] = _clamp(done_us - tail_start_us, op)
         else:
             # server segment missing (not captured/rescued): everything
             # past the issue span is wire-or-beyond — an honest catch-all
-            seg_us["wire"] = _clamp(done - issue_end, op)
+            seg_us["wire"] = _clamp(done_us - issue_end_us, op)
         _cap_to_total(seg_us, total_us, op)
         covered = sum(seg_us.values())
         seg_us["other"] = max(total_us - covered, 0.0)
@@ -306,19 +306,18 @@ def ops_from_blackbox(
             "ts": issue["ts"],
             "procs": len({(e["proc"], e["pid"]) for e in evs}),
         }
-        t0 = issue["ts"] * 1e6
-        done = reply["ts"] * 1e6
-        total_us = max(done - t0, 0.0)
+        t0_us = issue["ts"] * 1e6
+        done_us = reply["ts"] * 1e6
+        total_us = max(done_us - t0_us, 0.0)
         seg_us: dict[str, float] = {}
         if first_in is not None:
-            seg_us["wire"] = _clamp(first_in["ts"] * 1e6 - t0, op)
-            srv_end = first_in["ts"] * 1e6
+            in_ts_us = first_in["ts"] * 1e6
+            seg_us["wire"] = _clamp(in_ts_us - t0_us, op)
+            srv_end_us = in_ts_us
             if commit is not None:
-                seg_us["server"] = _clamp(
-                    commit["ts"] * 1e6 - first_in["ts"] * 1e6, op
-                )
-                srv_end = commit["ts"] * 1e6
-            seg_us["reply_lane"] = _clamp(done - srv_end, op)
+                seg_us["server"] = _clamp(commit["ts"] * 1e6 - in_ts_us, op)
+                srv_end_us = commit["ts"] * 1e6
+            seg_us["reply_lane"] = _clamp(done_us - srv_end_us, op)
         _cap_to_total(seg_us, total_us, op)
         covered = sum(seg_us.values())
         seg_us["other"] = max(total_us - covered, 0.0)

@@ -87,6 +87,14 @@ class FlowPolicy:
     #: receiver methods treated as in-place mutation of a tagged value
     mutating_methods: frozenset[str] = MUTATING_METHODS
 
+    def owns(self, tag: str) -> bool:
+        """Whether ``tag`` belongs to this policy's namespace. Policies
+        composed into one shared run (:class:`CompositePolicy`) must
+        keep disjoint namespaces (``rcu*``, ``decorated``, ``u:*``,
+        ``ck:*``, ``id:*``) and claim ONLY theirs, so a units tag never
+        leaks into the RCU policy's element/call_result shaping."""
+        return True
+
     def begin_function(
         self, relpath: str, cls_name: str | None, fn_name: str
     ) -> None:
@@ -140,6 +148,58 @@ class FlowPolicy:
         eval_expr: Callable[[ast.expr], Tags],
     ) -> None:
         """Every call site, after argument evaluation."""
+
+    # -- quantity-flow hooks (ISSUE 20) -------------------------------------
+    # binop/unary return RESULT tags and are called in BOTH passes (the
+    # fixpoint needs them to propagate); any finding they record must be
+    # gated on ``report`` — pass-1 tags are still growing, so a
+    # "disjoint units" verdict before the fixpoint can be transiently
+    # wrong. The on_* observers below are only called in the report
+    # pass, so they may record findings unconditionally.
+
+    def binop(
+        self, node: ast.AST, op: ast.operator, ltags: Tags, rtags: Tags,
+        report: bool,
+    ) -> Tags:
+        """Tags of ``l <op> r`` (also driven for ``AugAssign``, with the
+        statement as ``node``). Default: arithmetic yields fresh values
+        (the pre-v3 behavior)."""
+        return EMPTY
+
+    def unary(
+        self, node: ast.UnaryOp, op: ast.unaryop, tags: Tags, report: bool
+    ) -> Tags:
+        """Tags of ``<op> x``. Default: fresh."""
+        return EMPTY
+
+    def on_compare(self, node: ast.Compare, operand_tags: list[Tags]) -> None:
+        """A comparison chain, with the tags of ``[left, *comparators]``
+        (``node.ops`` carries the operators)."""
+
+    def on_bind(self, name: str, tags: Tags, stmt: ast.stmt) -> None:
+        """A value carrying ``tags`` bound to local/global NAME ``name``
+        (plain assignment targets; ``stmt.value`` is the source when the
+        statement has one)."""
+
+    def on_store(
+        self, kind: str, name: str, tags: Tags, stmt: ast.stmt
+    ) -> None:
+        """A value carrying ``tags`` stored into an attribute
+        (``kind="attr"``) or a constant-string subscript slot
+        (``kind="key"``) named ``name`` — the sink side of the units
+        suffix rules (wire header slots, config keys)."""
+
+    def on_keyword(self, call: ast.Call, kw_name: str, tags: Tags) -> None:
+        """A keyword argument ``kw_name=<value carrying tags>`` at a
+        call site (named-parameter sink check)."""
+
+    def finish_call(self, call: ast.Call, tags: Tags) -> Tags:
+        """Last word on a call's result tags, applied on EVERY path
+        (summary-resolved, fresh, and ``call_result``). This is where a
+        declared conversion function overrides even a resolved callee's
+        summary — ``to_ms(x)`` returns ms because the whitelist says so,
+        whatever its body's tags computed. Default: identity."""
+        return tags
 
 
 @dataclass
@@ -216,11 +276,18 @@ class FlowWalker:
             vtags = self._eval(stmt.value)
             t = stmt.target
             if isinstance(t, ast.Name):
-                cur = self.env.get(t.id, EMPTY)
+                # the target is a load+store: seed it so `total_ms +=
+                # dur_us` sees the suffix tag even on first write
+                cur = self.env.get(t.id, EMPTY) | self._p.seed(
+                    t, self._ctx.cls_name, self._ctx.relpath
+                )
                 self._mutation(stmt, "augassign", cur, ast.unparse(t))
+                self._p.binop(stmt, stmt.op, cur, vtags, self._report)
                 self.env[t.id] = cur | vtags
             elif isinstance(t, (ast.Subscript, ast.Attribute)):
                 base = self._eval(t.value)
+                tgt = self._p.seed(t, self._ctx.cls_name, self._ctx.relpath)
+                self._p.binop(stmt, stmt.op, tgt, vtags, self._report)
                 self._mutation(stmt, "augassign", base, ast.unparse(t))
             return
         if isinstance(stmt, ast.Delete):
@@ -312,6 +379,8 @@ class FlowWalker:
     def _assign(self, target: ast.expr, tags: Tags, stmt: ast.stmt) -> None:
         if isinstance(target, ast.Name):
             self.env[target.id] = tags
+            if self._report:
+                self._p.on_bind(target.id, tags, stmt)
             return
         if isinstance(target, (ast.Tuple, ast.List)):
             for i, elt in enumerate(target.elts):
@@ -324,10 +393,15 @@ class FlowWalker:
             base = self._eval(target.value)
             self._eval(target.slice)
             self._mutation(stmt, "setitem", base, ast.unparse(target))
+            if self._report and isinstance(target.slice, ast.Constant) \
+                    and isinstance(target.slice.value, str):
+                self._p.on_store("key", target.slice.value, tags, stmt)
             return
         if isinstance(target, ast.Attribute):
             base = self._eval(target.value)
             self._mutation(stmt, "setattr", base, ast.unparse(target))
+            if self._report:
+                self._p.on_store("attr", target.attr, tags, stmt)
             return
         if isinstance(target, ast.Starred):
             self._assign(target.value, tags, stmt)
@@ -417,11 +491,19 @@ class FlowWalker:
             else:
                 self._eval(expr.elt)
             return EMPTY
-        if isinstance(expr, (ast.BinOp, ast.UnaryOp, ast.Compare)):
-            for sub in ast.iter_child_nodes(expr):
-                if isinstance(sub, ast.expr):
-                    self._eval(sub)
-            return EMPTY  # arithmetic/comparison yields fresh values
+        if isinstance(expr, ast.BinOp):
+            l = self._eval(expr.left)
+            r = self._eval(expr.right)
+            return p.binop(expr, expr.op, l, r, self._report)
+        if isinstance(expr, ast.UnaryOp):
+            t = self._eval(expr.operand)
+            return p.unary(expr, expr.op, t, self._report)
+        if isinstance(expr, ast.Compare):
+            operand_tags = [self._eval(expr.left)]
+            operand_tags.extend(self._eval(c) for c in expr.comparators)
+            if self._report:
+                p.on_compare(expr, operand_tags)
+            return EMPTY  # booleans are unit-free
         if isinstance(expr, ast.Lambda):
             return EMPTY  # body runs later; out of intraprocedural scope
         # constants, f-strings, slices...
@@ -438,7 +520,9 @@ class FlowWalker:
             recv_tags = self._eval(fn.value)
         arg_tags = [self._eval(a) for a in call.args]
         for kw in call.keywords:
-            self._eval(kw.value)
+            kw_tags = self._eval(kw.value)
+            if self._report and kw.arg is not None:
+                p.on_keyword(call, kw.arg, kw_tags)
         # receiver-mutating methods on a tagged value
         if (
             isinstance(fn, ast.Attribute)
@@ -473,13 +557,14 @@ class FlowWalker:
                         call, "callee", arg_tags[i],
                         f"{ast.unparse(fn)}(...) arg {i}",
                     )
-        if resolved:
-            return out
-        if isinstance(fn, ast.Name) and fn.id in FRESH_CALLS:
-            return EMPTY
-        if isinstance(fn, ast.Attribute) and fn.attr in FRESH_CALLS:
-            return EMPTY
-        return p.call_result(call, recv_tags, arg_tags)
+        if not resolved:
+            if (isinstance(fn, ast.Name) and fn.id in FRESH_CALLS) or (
+                isinstance(fn, ast.Attribute) and fn.attr in FRESH_CALLS
+            ):
+                out = EMPTY
+            else:
+                out = p.call_result(call, recv_tags, arg_tags)
+        return p.finish_call(call, out)
 
 
 class DataflowAnalysis:
@@ -560,3 +645,106 @@ class DataflowAnalysis:
                 self.policy, self.graph, ctx, self.summaries,
                 self._lock_key_fn(ctx), report=True,
             ).run()
+
+
+class CompositePolicy(FlowPolicy):
+    """Fan one walk out to several policies with disjoint tag
+    namespaces — the shared-fixpoint optimization (ISSUE 20): the
+    package-wide summary fixpoint is the expensive half of every
+    dataflow checker, and with N policies composed it runs ONCE instead
+    of N times. Each sub-policy sees only the tags its :meth:`owns`
+    claims (plus the shared ``param:<i>`` infrastructure handled by the
+    walker itself), so composition cannot change any policy's verdict —
+    tag sets here are the union of what each solo run would compute."""
+
+    def __init__(self, policies: list[FlowPolicy]):
+        self.policies = list(policies)
+        mm: frozenset[str] = frozenset()
+        for p in self.policies:
+            mm |= p.mutating_methods
+        self.mutating_methods = mm
+
+    def _own(self, p: FlowPolicy, tags: Tags) -> Tags:
+        return frozenset(t for t in tags if not is_param_tag(t) and p.owns(t))
+
+    def begin_function(self, relpath, cls_name, fn_name):
+        for p in self.policies:
+            p.begin_function(relpath, cls_name, fn_name)
+
+    def seed(self, expr, cls_name, relpath):
+        out = EMPTY
+        for p in self.policies:
+            out |= p.seed(expr, cls_name, relpath)
+        return out
+
+    def element(self, tags, index):
+        out = EMPTY
+        for p in self.policies:
+            out |= p.element(self._own(p, tags), index)
+        return out
+
+    def call_result(self, call, recv_tags, arg_tags):
+        out = EMPTY
+        for p in self.policies:
+            out |= p.call_result(
+                call, self._own(p, recv_tags),
+                [self._own(p, a) for a in arg_tags],
+            )
+        return out
+
+    def binop(self, node, op, ltags, rtags, report):
+        out = EMPTY
+        for p in self.policies:
+            out |= p.binop(
+                node, op, self._own(p, ltags), self._own(p, rtags), report
+            )
+        return out
+
+    def unary(self, node, op, tags, report):
+        out = EMPTY
+        for p in self.policies:
+            out |= p.unary(node, op, self._own(p, tags), report)
+        return out
+
+    def on_mutation(self, node, kind, tags, held, desc):
+        for p in self.policies:
+            own = self._own(p, tags)
+            if own:
+                p.on_mutation(node, kind, own, held, desc)
+
+    def on_load(self, expr, cls_name, held, fn_name):
+        for p in self.policies:
+            p.on_load(expr, cls_name, held, fn_name)
+
+    def on_call(self, call, arg_tags, held, eval_expr):
+        for p in self.policies:
+            own_eval = (
+                lambda e, _p=p: self._own(_p, eval_expr(e))
+            )
+            p.on_call(
+                call, [self._own(p, a) for a in arg_tags], held, own_eval
+            )
+
+    def on_compare(self, node, operand_tags):
+        for p in self.policies:
+            p.on_compare(node, [self._own(p, t) for t in operand_tags])
+
+    def on_bind(self, name, tags, stmt):
+        for p in self.policies:
+            p.on_bind(name, self._own(p, tags), stmt)
+
+    def on_store(self, kind, name, tags, stmt):
+        for p in self.policies:
+            p.on_store(kind, name, self._own(p, tags), stmt)
+
+    def on_keyword(self, call, kw_name, tags):
+        for p in self.policies:
+            p.on_keyword(call, kw_name, self._own(p, tags))
+
+    def finish_call(self, call, tags):
+        # each policy rewrites only its own namespace slice; everything
+        # else (other namespaces, param pseudo-tags) passes through
+        for p in self.policies:
+            own = self._own(p, tags)
+            tags = (tags - own) | p.finish_call(call, own)
+        return tags

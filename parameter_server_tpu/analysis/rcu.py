@@ -42,10 +42,13 @@ from parameter_server_tpu.analysis.callgraph import CallGraph, shared_callgraph
 from parameter_server_tpu.analysis.core import Finding, PackageIndex
 from parameter_server_tpu.analysis.dataflow import (
     EMPTY,
-    DataflowAnalysis,
     FlowPolicy,
     Tags,
     is_param_tag,
+)
+from parameter_server_tpu.analysis.flowrun import (
+    flow_policy,
+    register_flow_policy,
 )
 
 #: tag carried by the raw publish tuple; element 0 of it is TAG_SNAP
@@ -158,6 +161,7 @@ def discover_publishers(index: PackageIndex) -> list[Publisher]:
 class _RcuPolicy(FlowPolicy):
     def __init__(self, pubs: list[Publisher], graph: CallGraph):
         self._graph = graph
+        self.pubs = pubs
         self._by_cls = {p.cls: p for p in pubs}
         self._snap_props = {p.snap_prop for p in pubs}
         self._raw_attrs = {p.raw_attr for p in pubs}
@@ -205,6 +209,9 @@ class _RcuPolicy(FlowPolicy):
             self.findings.append((line, self._relpath, msg))
 
     # -- FlowPolicy hooks --------------------------------------------------
+
+    def owns(self, tag: str) -> bool:
+        return tag in (TAG_SNAP, TAG_PUB)
 
     def begin_function(
         self, relpath: str, cls_name: str | None, fn_name: str
@@ -316,16 +323,24 @@ def _check_raw_stores(
                         ))
 
 
-def check_rcu(index: PackageIndex) -> list[Finding]:
+def _policy_factory(index: PackageIndex) -> _RcuPolicy | None:
     pubs = discover_publishers(index)
     if not pubs:
+        return None
+    return _RcuPolicy(pubs, shared_callgraph(index))
+
+
+register_flow_policy("rcu", _policy_factory)
+
+
+def check_rcu(index: PackageIndex) -> list[Finding]:
+    policy = flow_policy(index, "rcu")
+    if policy is None:  # no RCU publishers in this index
         return []
-    graph = shared_callgraph(index)
-    policy = _RcuPolicy(pubs, graph)
-    DataflowAnalysis(index, policy, graph).run()
+    assert isinstance(policy, _RcuPolicy)
     out = [
         Finding("rcu", rel, line, msg)
         for line, rel, msg in policy.findings
     ]
-    _check_raw_stores(index, pubs, out)
+    _check_raw_stores(index, policy.pubs, out)
     return out

@@ -42,12 +42,11 @@ from __future__ import annotations
 
 import ast
 
-from parameter_server_tpu.analysis.callgraph import shared_callgraph
 from parameter_server_tpu.analysis.core import Finding, PackageIndex
-from parameter_server_tpu.analysis.dataflow import (
-    DataflowAnalysis,
-    FlowPolicy,
-    Tags,
+from parameter_server_tpu.analysis.dataflow import FlowPolicy, Tags
+from parameter_server_tpu.analysis.flowrun import (
+    flow_policy,
+    register_flow_policy,
 )
 
 _ENCODE_FN = "_encode_bin_header"
@@ -415,6 +414,9 @@ class _DecorationPolicy(FlowPolicy):
         self.findings: list[tuple[str, int]] = []
         self._seen: set[tuple[str, int]] = set()
 
+    def owns(self, tag: str) -> bool:
+        return tag == TAG_DECORATED
+
     def begin_function(
         self, relpath: str, cls_name: str | None, fn_name: str
     ) -> None:
@@ -443,7 +445,7 @@ class _DecorationPolicy(FlowPolicy):
                 self.findings.append(key)
 
 
-def _check_decoration(index: PackageIndex, out: list[Finding]) -> None:
+def _decoration_factory(index: PackageIndex) -> _DecorationPolicy | None:
     modules: set[str] = set()
     for f in index.files:
         names = {
@@ -454,9 +456,18 @@ def _check_decoration(index: PackageIndex, out: list[Finding]) -> None:
         if "decorated" in names and "queue_reply" in names:
             modules.add(f.relpath)
     if not modules:
+        return None
+    return _DecorationPolicy(modules)
+
+
+register_flow_policy("wireproto-decoration", _decoration_factory)
+
+
+def _check_decoration(index: PackageIndex, out: list[Finding]) -> None:
+    policy = flow_policy(index, "wireproto-decoration")
+    if policy is None:  # no module defines both helpers
         return
-    policy = _DecorationPolicy(modules)
-    DataflowAnalysis(index, policy, shared_callgraph(index)).run()
+    assert isinstance(policy, _DecorationPolicy)
     for rel, line in sorted(policy.findings):
         out.append(Finding(
             "wireproto", rel, line,

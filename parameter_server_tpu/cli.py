@@ -302,7 +302,8 @@ def _build_parser() -> argparse.ArgumentParser:
         help="run pslint — the project-native static analyzer "
         "(python -m parameter_server_tpu.analysis): lock-order, "
         "blocking-under-lock, settle-exactly-once, counter/config "
-        "contracts, trace hygiene; exits nonzero on findings",
+        "contracts, trace hygiene, and the quantity-flow triple "
+        "(units / clockdomain / idtype); exits nonzero on findings",
     )
     li.add_argument(
         "--checker", action="append", default=None,
@@ -320,6 +321,12 @@ def _build_parser() -> argparse.ArgumentParser:
     li.add_argument(
         "--update-baseline", action="store_true",
         help="rewrite --baseline from the current findings",
+    )
+    li.add_argument(
+        "--changed-only", default=None, metavar="REF",
+        help="report only findings in files changed vs this git ref "
+        "(the analysis still covers the whole package — fast pre-push "
+        "iteration, not the gate of record)",
     )
 
     ck = sub.add_parser(
@@ -369,6 +376,12 @@ def _build_parser() -> argparse.ArgumentParser:
         "--lint-baseline", default="", metavar="FILE",
         help="pass through to `lint --baseline` (omit for a plain "
         "zero-findings lint)",
+    )
+    vf.add_argument(
+        "--lint-changed-only", default="", metavar="REF",
+        help="pass through to `lint --changed-only REF` (report only "
+        "findings in files changed vs the ref; the analysis still "
+        "covers the whole package)",
     )
     vf.add_argument(
         "--max-states", type=int, default=200_000,
@@ -1140,6 +1153,8 @@ def run_verify(args: argparse.Namespace) -> int:
     lint_argv: list[str] = []
     if args.lint_baseline:
         lint_argv += ["--baseline", args.lint_baseline]
+    if args.lint_changed_only:
+        lint_argv += ["--changed-only", args.lint_changed_only]
     _stage("lint", lambda: lint_main(lint_argv))
     _stage(
         "check",
@@ -1189,6 +1204,8 @@ def main(argv: list[str] | None = None) -> int:
             lint_argv += ["--baseline", args.baseline]
         if args.update_baseline:
             lint_argv.append("--update-baseline")
+        if args.changed_only:
+            lint_argv += ["--changed-only", args.changed_only]
         return lint_main(lint_argv)
     if args.cmd == "check":
         # no config file: the model checker verifies protocol SPECS and

@@ -82,6 +82,7 @@ from parameter_server_tpu.parallel.control import (
     RpcServer,
 )
 from parameter_server_tpu.utils import flightrec, trace
+from parameter_server_tpu.utils.clock import now_wall_us, skew_clamped_age_s
 from parameter_server_tpu.utils.config import PSConfig, ServeConfig, ServerConfig
 from parameter_server_tpu.utils.flightrec import watchdog
 from parameter_server_tpu.utils.heartbeat import HeartbeatReporter, host_stats
@@ -262,7 +263,7 @@ class ShardServer:
         # from, never a neighbour publish.
         self._pub: tuple[dict[str, Any], int, int] = (
             updater.init(key_range.size, vdim), self._ver_base + 1,
-            int(time.time() * 1e6),
+            now_wall_us(),
         )
         self._serve_cfg = svcfg
         # freshness plane: this range's traffic/age matrix (per-range
@@ -397,7 +398,7 @@ class ShardServer:
         checkpoint load) goes through here, so a pull reply's ``ver``
         always identifies exactly the table its rows came from."""
         ver = self._pub[1] + 1
-        self._pub = (new_state, ver, int(time.time() * 1e6))
+        self._pub = (new_state, ver, now_wall_us())
         # flight recorder: every publish, whatever the writer — the
         # postmortem's version-regression detector reads this stream
         flightrec.record("rcu.publish", ver=ver)
@@ -1107,9 +1108,7 @@ class ShardServer:
                     self._range_scope.pull(
                         sum(a.nbytes for a in ent.arrays.values())
                     )
-                    self._range_scope.age(
-                        max(time.time() - pts / 1e6, 0.0)
-                    )
+                    self._range_scope.age(skew_clamped_age_s(pts))
                     return ent.rep, ent.arrays
                 ent = None  # owner failed or timed out: encode ourselves
         try:
@@ -1129,9 +1128,10 @@ class ShardServer:
         self._bump("pulls")
         self._bump("pull_encodes")
         # per-range matrix: rows left this range at this snapshot's age
-        # (publish and serve clocks are the same process's — skew-free)
+        # (publish and serve clocks are usually this process's own, but
+        # a replicated pts can be a peer's — the clamp absorbs the skew)
         self._range_scope.pull(sum(a.nbytes for a in out.values()))
-        self._range_scope.age(max(time.time() - pts / 1e6, 0.0))
+        self._range_scope.age(skew_clamped_age_s(pts))
         if ent is not None:
             self._enc_fill(ck, ent, rep, out)
         return rep, out
@@ -1907,13 +1907,15 @@ class ServerHandle:
 
     def _book_serve_age(self, age_us: float, src: str) -> None:
         """Book the realized data age ONE serve handed its consumer
-        (freshness plane, ISSUE 17): the global ``serve.age`` histogram
-        (what `cli top`'s age column and the ``pull_age_ms`` SLO read),
+        (freshness plane, ISSUE 17): the global ``serve.age_s``
+        histogram (what `cli top`'s age column and the ``pull_age_ms``
+        SLO read; the pre-rename name ``serve.age`` stays a read-side
+        alias for beats from older nodes — utils/timeseries.py),
         this handle's per-range matrix when it knows its range, and the
         flight recorder (a shed-stale serve near the staleness ceiling
         is exactly the context a postmortem wants on the timeline)."""
         age_s = max(float(age_us), 0.0) / 1e6
-        latency_histograms.observe("serve.age", age_s)
+        latency_histograms.observe("serve.age_s", age_s)
         if self._range_scope is not None:
             self._range_scope.age(age_s)
         flightrec.record(

@@ -1,0 +1,71 @@
+"""Canonical clock helpers: every timestamp names its clock AND unit.
+
+pslint v3 (ISSUE 20) types every value with its quantity: the
+``clockdomain`` checker tags timestamps by source clock (wall /
+monotonic / perf_counter / a PEER's wall echoed through a wire field)
+and flags cross-domain mixing, and the ``units`` checker tracks the
+us/ms/s lattice. Bare ``time.time()`` calls defeat both half the time:
+the call itself is typed (wall, seconds) but the first un-suffixed
+local it lands in drops the unit. These wrappers bake clock and unit
+into the NAME the dataflow reads (``now_wall_us`` -> ck:wall + u:us),
+so call sites stay typed for free. New code takes its timestamps here;
+``time.*`` stays fine in tests and one-off scripts.
+
+``skew_clamped_age_s`` is the one sanctioned place a foreign-wall
+publish timestamp (a peer-echoed ``pts``) meets the local wall clock:
+"clamp" in the name declares it to the clockdomain checker, and the
+floor-at-0 IS the skew handling (PR 14's lesson — cross-node wall
+deltas go negative, and negative age must never reach a histogram).
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = [
+    "now_mono_s",
+    "now_mono_us",
+    "now_perf_s",
+    "now_wall_s",
+    "now_wall_us",
+    "skew_clamped_age_s",
+]
+
+
+def now_wall_s() -> float:
+    """Wall-clock epoch seconds (``time.time``): the only clock that is
+    meaningful ACROSS processes — and only modulo NTP skew, so wall
+    deltas taken against a peer's stamp go through a skew clamp."""
+    return time.time()
+
+
+def now_wall_us() -> int:
+    """Wall-clock epoch microseconds (the wire/publish-ts granularity:
+    binary-header i64 slots and the RCU publish tuple carry these)."""
+    return int(time.time() * 1e6)
+
+
+def now_mono_s() -> float:
+    """Monotonic seconds (``time.monotonic``): in-process intervals —
+    deadlines, backoff, cache residence. Never crosses a process."""
+    return time.monotonic()
+
+
+def now_mono_us() -> int:
+    """Monotonic microseconds, for µs-granular in-process intervals."""
+    return int(time.monotonic() * 1e6)
+
+
+def now_perf_s() -> float:
+    """High-resolution perf counter seconds (``time.perf_counter``):
+    micro-benchmark timing inside one process."""
+    return time.perf_counter()
+
+
+def skew_clamped_age_s(pts_us: float) -> float:
+    """Realized age (seconds) of a µs-epoch publish timestamp against
+    THIS process's wall clock, floored at 0: when ``pts_us`` came from
+    a peer (or an NTP step landed between publish and serve), the raw
+    difference can be negative by the cross-node skew — a negative age
+    is clamped, never booked."""
+    return max(time.time() - float(pts_us) / 1e6, 0.0)

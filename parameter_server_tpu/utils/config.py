@@ -531,7 +531,7 @@ class SloConfig:
         # freshness-armed serve/gate emits the series — the shipped
         # thresholds are the paper's serving-tier defaults (age under a
         # second; lag within the configured bound's usual allowance)
-        "pull_age_ms p99:serve.age <= 1000",
+        "pull_age_ms p99:serve.age_s <= 1000",
         "ssp_lag_clocks p99:ssp.lag_clocks.n <= 8",
         # the audit plane's alert hook (ISSUE 14): the coordinator bumps
         # audit_violations in its own ring, so a sustained violation

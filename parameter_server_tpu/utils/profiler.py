@@ -163,18 +163,20 @@ class SamplingProfiler:
         longer than ~2 sample intervals close everything."""
         with self._lock:
             samples = list(self._samples)
-        by_tid: dict[int, list[tuple[float, tuple[str, ...]]]] = {}
-        for ts, tid, stack in samples:
-            by_tid.setdefault(tid, []).append((ts, stack))
+        # "thread", not "tid": in this package tid means TRACE id — the
+        # OS thread id only surfaces in the Chrome-format "tid" field
+        by_thread: dict[int, list[tuple[float, tuple[str, ...]]]] = {}
+        for ts, thread, stack in samples:
+            by_thread.setdefault(thread, []).append((ts, stack))
         pid = os.getpid()
         dt = 1.0 / self.hz
         events: list[dict[str, Any]] = []
 
-        for tid, seq in by_tid.items():
-            seq.sort(key=lambda x: x[0])
+        for thread, thread_samples in by_thread.items():
+            thread_samples.sort(key=lambda x: x[0])
             open_frames: list[tuple[str, float]] = []  # (label, start_ts)
 
-            def close_from(depth: int, end_ts: float, tid=tid) -> None:
+            def close_from(depth: int, end_ts: float, thread=thread) -> None:
                 while len(open_frames) > depth:
                     label, t0 = open_frames.pop()
                     events.append({
@@ -184,11 +186,11 @@ class SamplingProfiler:
                         "ts": t0 * 1e6,
                         "dur": max((end_ts - t0) * 1e6, 1.0),
                         "pid": pid,
-                        "tid": tid,
+                        "tid": thread,
                     })
 
             prev_ts: float | None = None
-            for ts, stack in seq:
+            for ts, stack in thread_samples:
                 if prev_ts is not None and ts - prev_ts > 2.5 * dt:
                     close_from(0, prev_ts + dt)  # sampling gap: restart
                 common = 0

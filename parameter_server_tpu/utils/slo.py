@@ -46,7 +46,12 @@ from parameter_server_tpu.utils.metrics import (
     split_range_series,
     wire_counters,
 )
-from parameter_server_tpu.utils.timeseries import TimeSeriesRing, series_scale
+from parameter_server_tpu.utils.timeseries import (
+    LEGACY_SERIES,
+    TimeSeriesRing,
+    canonical_series,
+    series_scale,
+)
 
 
 @dataclass
@@ -83,7 +88,10 @@ def parse_rule(spec: str) -> SloRule:
             "with a ':<series>' suffix"
         )
     rule = SloRule(
-        name=toks[0], kind=kind, series=series, threshold=float(toks[3])
+        # persisted rule strings may predate a series' unit-suffix
+        # rename (serve.age -> serve.age_s): normalize at parse time
+        name=toks[0], kind=kind, series=canonical_series(series),
+        threshold=float(toks[3]),
     )
     rest = toks[4:]
     if len(rest) % 2:
@@ -141,6 +149,12 @@ class SloEngine:
                 saw_data = True  # a counter absent from a delta is 0/s
             else:
                 snap = e["hists"].get(rule.series)
+                if snap is None:
+                    # mixed-version cluster: an older node's beats still
+                    # carry the pre-rename series name
+                    legacy = LEGACY_SERIES.get(rule.series)
+                    if legacy is not None:
+                        snap = e["hists"].get(legacy)
                 if not snap or not snap.get("buckets"):
                     # no observations this entry (or a bucketless
                     # saturation summary — no percentile): no verdict
@@ -307,7 +321,7 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
         q_p99 = p99.get("server.apply_queue.n", 0.0)
         # realized data age of this node's serves (ms) — the freshness
         # plane's headline number (ISSUE 17)
-        age_p99 = p99.get("serve.age", 0.0)
+        age_p99 = _first(p99, "serve.age_s", "serve.age")
         burning = ",".join(h.get("burning") or []) or "-"
         score = h.get("score")
         # the audit column: violations attributed to this node's event
@@ -386,7 +400,7 @@ def format_top(rep: dict[str, Any], window_s: float) -> str:
     stale_rng: tuple[str | None, float] = (None, 0.0)
     for nid, s in series.items():
         for name, v in ((s or {}).get("p99") or {}).items():
-            if name == "serve.age" and v > stalest[1]:
+            if canonical_series(name) == "serve.age_s" and v > stalest[1]:
                 stalest = (nid, v)
             parsed = split_range_series(name)
             if parsed and parsed[1] == "age" and v > stale_rng[1]:

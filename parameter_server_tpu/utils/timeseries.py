@@ -144,6 +144,23 @@ def series_scale(name: str) -> float:
     return 1e6 if name.endswith(".n") else 1e3
 
 
+#: committed series renamed for unit-suffix hygiene (pslint v3's
+#: ``units`` checker: a duration-valued series name must carry its
+#: unit): old name -> canonical. Rule strings and dashboard lookups
+#: canonicalize through here, so persisted ``[slo] rules`` entries and
+#: beats from pre-rename nodes in a mixed-version cluster keep working.
+SERIES_ALIASES: dict[str, str] = {
+    "serve.age": "serve.age_s",
+}
+#: canonical -> legacy, for read-side fallbacks against old beats
+LEGACY_SERIES: dict[str, str] = {v: k for k, v in SERIES_ALIASES.items()}
+
+
+def canonical_series(name: str) -> str:
+    """The canonical (unit-suffixed) name for a telemetry series."""
+    return SERIES_ALIASES.get(name, name)
+
+
 class TimeSeriesRing:
     """Bounded ring of timestamped telemetry deltas (thread-safe).
 

@@ -168,9 +168,9 @@ class TestServeAge:
             snap = latency_histograms.snapshot()
             # both the global headline series and this range's matrix
             # booked the realized age of the serve
-            assert snap["serve.age"]["count"] >= 1
+            assert snap["serve.age_s"]["count"] >= 1
             assert snap["range.0-256.age"]["count"] >= 1
-            age_s = hist_percentile(snap["serve.age"], 1.0)
+            age_s = hist_percentile(snap["serve.age_s"], 1.0)
             # log2 bucket edges: a ~60ms age lands in a bucket whose
             # reported edge is >= ~32ms and nowhere near seconds
             assert 0.02 <= age_s <= 5.0
@@ -189,12 +189,12 @@ class TestServeAge:
             h.pull(KEYS)  # wire fill
             h.pull(KEYS)  # fresh cache hit — a local serve, still aged
             assert wire_counters.get("serve_cache_hits") == 1
-            c0 = latency_histograms.snapshot()["serve.age"]["count"]
+            c0 = latency_histograms.snapshot()["serve.age_s"]["count"]
             assert c0 >= 2
             time.sleep(0.06)  # past the TTL: next pull revalidates
             h.pull(KEYS)
             assert wire_counters.get("serve_cache_validates") >= 1
-            c1 = latency_histograms.snapshot()["serve.age"]["count"]
+            c1 = latency_histograms.snapshot()["serve.age_s"]["count"]
             assert c1 > c0
         finally:
             h.shutdown()
@@ -219,7 +219,7 @@ class TestServeAge:
             time.sleep(0.02)  # past the TTL, inside max_stale
             h.pull(KEYS)  # server sheds; the cached rows serve
             assert wire_counters.get("serve_shed_served") >= 1
-            assert latency_histograms.snapshot()["serve.age"]["count"] >= 2
+            assert latency_histograms.snapshot()["serve.age_s"]["count"] >= 2
             # every serve source lands on the flight recorder timeline
             srcs = {
                 e[3].get("src") for e in flightrec.events()
@@ -407,9 +407,14 @@ class TestDormantSloLifecycle:
         assert "replication_lag_s" not in fired
 
     def test_first_hot_emit_lights_the_freshness_rule(self):
+        # the PRE-rename rule string and PRE-rename beats: the rule
+        # canonicalizes to serve.age_s at parse and the evaluator falls
+        # back to the legacy series name, so a mixed-version cluster
+        # with persisted old rule strings keeps alerting
         rule = slo.parse_rule(
             "pull_age_ms p99:serve.age <= 1000 target 0.9 burn 2"
         )
+        assert rule.series == "serve.age_s"
         eng = slo.SloEngine([rule], short_window_s=4, long_window_s=8)
         # serve.age observations around ~4s realized age: p99 >> 1000ms
         ring = self._ring(lambda i: {
@@ -430,6 +435,8 @@ class TestFormatSurfaces:
                           "range.0-256.pull_bytes": 4096.0},
                 "hist_rates": {"server.pull": 40.0},
                 "p50": {"range.0-256.age": 12.0},
+                # legacy series name on purpose: an old node's beats
+                # must still render through the serve.age_s alias
                 "p99": {"serve.age": 88.0, "range.0-256.age": 96.0,
                         "range.0-256.apply": 1.5},
             }},

@@ -392,17 +392,28 @@ class TestSuppressions:
 
 class TestPackageClean:
     def test_analyzer_runs_clean_over_the_real_package(self):
+        # doubles as the perf gate (ISSUE 20 acceptance): the 17-checker
+        # run shares ONE dataflow fixpoint, so the full lint must stay
+        # within a generous absolute budget — a second fixpoint (or a
+        # re-parse per checker) would blow straight through it
+        t0 = time.monotonic()
         findings = analyze_package()
+        elapsed_s = time.monotonic() - t0
         assert findings == [], "\n".join(f.render() for f in findings)
+        assert elapsed_s < 90.0, (
+            f"full 17-checker lint took {elapsed_s:.1f}s — the shared "
+            "dataflow fixpoint (analysis/flowrun.py) has regressed"
+        )
 
     def test_registry_matches_the_documented_inventory(self):
-        # ISSUE 10 acceptance: 14 registered checkers (11 + the psmc
-        # conformance pair + flightrec-contract); the README inventory
-        # table tracks this set
-        assert len(CHECKERS) == 14
+        # ISSUE 20 acceptance: 17 registered checkers (ISSUE 10's 14 +
+        # the quantity-flow triple); the README inventory table tracks
+        # this set
+        assert len(CHECKERS) == 17
         assert {
             "rcu", "wireproto", "stale-pragma", "spec-conformance",
             "model-invariants", "flightrec-contract",
+            "units", "clockdomain", "idtype",
         } <= set(CHECKERS)
 
     def test_module_entry_exits_zero(self):
@@ -978,6 +989,413 @@ def serve(conn):
         assert members == {"_BF2_VER", "_BF2_IF_NEWER", "_BF2_NOT_MODIFIED"}
         fs = analyze_package(checkers=_only("wireproto"))
         assert fs == [], "\n".join(f.render() for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# pslint v3 (ISSUE 20): units / clockdomain / idtype quantity flow
+# ---------------------------------------------------------------------------
+
+
+class TestUnitsChecker:
+    def test_cross_unit_add_fires(self):
+        src = "def f(lat_ms, svc_us):\n    return lat_ms + svc_us\n"
+        fs = _run(src, "units")
+        assert len(fs) == 1 and "cross-unit +" in fs[0].message
+        assert "u:ms" in fs[0].message and "u:us" in fs[0].message
+
+    def test_literal_factor_conversion_is_clean(self):
+        src = "def f(lat_ms, svc_us):\n    return lat_ms * 1000 + svc_us\n"
+        assert _run(src, "units") == []
+        src = "def f(svc_us):\n    lat_ms = svc_us / 1000\n    return lat_ms\n"
+        assert _run(src, "units") == []
+
+    def test_cross_unit_comparison_fires(self):
+        src = "def f(budget_ms, wait_s):\n    return wait_s > budget_ms\n"
+        fs = _run(src, "units")
+        assert len(fs) == 1 and "comparison" in fs[0].message
+
+    def test_interprocedural_us_into_ms_sink_fires(self):
+        # the named acceptance drill: a µs value through a helper into
+        # a _ms-suffixed binding, two functions apart
+        src = (
+            "def _ident(x):\n"
+            "    return x\n"
+            "def g(wait_us):\n"
+            "    budget_ms = _ident(wait_us)\n"
+            "    return budget_ms\n"
+        )
+        fs = _run(src, "units")
+        assert len(fs) == 1
+        assert "u:us" in fs[0].message and "'budget_ms'" in fs[0].message
+
+    def test_interprocedural_with_conversion_is_clean(self):
+        src = (
+            "def _ident(x):\n"
+            "    return x\n"
+            "def g(wait_us):\n"
+            "    budget_ms = _ident(wait_us) / 1000\n"
+            "    return budget_ms\n"
+        )
+        assert _run(src, "units") == []
+
+    def test_declared_conversion_whitelist_overrides_summary(self):
+        # [tool.pslint] unit-conversions: "to_ms -> ms" retypes the
+        # call RESULT even though to_ms's own summary passes µs through
+        src = (
+            "def to_ms(x):\n"
+            "    return x / 1000\n"
+            "def g(wait_us):\n"
+            "    budget_ms = to_ms(wait_us)\n"
+            "    return budget_ms\n"
+        )
+        body = (
+            "def to_ms(x):\n"
+            "    return x\n"  # identity body: summary says µs in = µs out
+            "def g(wait_us):\n"
+            "    budget_ms = to_ms(wait_us)\n"
+            "    return budget_ms\n"
+        )
+        cfg = PslintConfig(unit_conversions=["to_ms -> ms"])
+        index = PackageIndex.from_sources({"s.py": body}, config=cfg)
+        assert run_checkers(index, _only("units"), cfg) == []
+        # without the declaration the same source fires
+        assert len(_run(body, "units")) == 1
+        # and a real conversion body needs no declaration at all
+        assert _run(src, "units") == []
+
+    def test_unsuffixed_duration_series_name_fires(self):
+        src = (
+            "def observe(name, seconds):\n"
+            "    pass\n"
+            "def book(age_s):\n"
+            "    observe('serve.age', age_s)\n"
+        )
+        fs = _run(src, "units")
+        assert len(fs) == 1 and "'serve.age'" in fs[0].message
+        assert "unit suffix" in fs[0].message
+
+    def test_suffixed_and_count_series_names_are_clean(self):
+        src = (
+            "def observe(name, seconds):\n"
+            "    pass\n"
+            "def book(age_s):\n"
+            "    observe('serve.age_s', age_s)\n"
+            "    observe('ssp.lag_clocks.n', age_s)\n"
+        )
+        assert _run(src, "units") == []
+
+    def test_pragma_suppresses_and_stale_pragma_audits(self):
+        hot = (
+            "def f(lat_ms, svc_us):\n"
+            "    return lat_ms + svc_us  # psl: ignore[units]: crafted\n"
+        )
+        assert analyze_sources({"s.py": hot}) == []
+        cold = (
+            "def f(lat_ms, svc_ms):\n"
+            "    return lat_ms + svc_ms  # psl: ignore[units]: crafted\n"
+        )
+        fs = analyze_sources({"s.py": cold})
+        assert len(fs) == 1 and fs[0].checker == "stale-pragma"
+
+
+class TestClockdomainChecker:
+    def test_wall_minus_mono_fires(self):
+        src = (
+            "import time\n"
+            "def f():\n"
+            "    t0 = time.monotonic()\n"
+            "    return time.time() - t0\n"
+        )
+        fs = _run(src, "clockdomain")
+        assert len(fs) == 1 and "subtraction" in fs[0].message
+        assert "wall" in fs[0].message and "monotonic" in fs[0].message
+
+    def test_same_domain_subtraction_is_clean(self):
+        src = (
+            "import time\n"
+            "def f():\n"
+            "    t0 = time.monotonic()\n"
+            "    return time.monotonic() - t0\n"
+        )
+        assert _run(src, "clockdomain") == []
+
+    def test_durations_from_different_clocks_compare_clean(self):
+        # ts - ts is domain-free: comparing a wall duration against a
+        # mono duration is legitimate
+        src = (
+            "import time\n"
+            "def f(a, b):\n"
+            "    d1 = time.time() - a\n"
+            "    d2 = time.monotonic() - b\n"
+            "    return d1 > d2\n"
+        )
+        fs = _run(src, "clockdomain")
+        assert all("comparison" not in f.message for f in fs)
+
+    def test_interprocedural_wall_two_calls_from_mono_fires(self):
+        # the named acceptance drill: a wall timestamp returned through
+        # two helpers still collides with a monotonic one
+        src = (
+            "import time\n"
+            "def _wall():\n"
+            "    return time.time()\n"
+            "def _issue():\n"
+            "    return _wall()\n"
+            "def f():\n"
+            "    t0 = time.monotonic()\n"
+            "    return _issue() - t0\n"
+        )
+        fs = _run(src, "clockdomain")
+        assert len(fs) == 1 and "subtraction" in fs[0].message
+
+    def test_mixing_inside_clamp_call_args_is_sanctioned(self):
+        src = (
+            "import time\n"
+            "def _skew_clamp(raw_s):\n"
+            "    return max(raw_s, 0.0)\n"
+            "def f(pts):\n"
+            "    return _skew_clamp(time.time() - pts / 1e6)\n"
+        )
+        assert _run(src, "clockdomain") == []
+
+    def test_mixing_inside_clamp_named_body_is_sanctioned(self):
+        src = (
+            "import time\n"
+            "def age_clamped(pts):\n"
+            "    return max(time.time() - pts / 1e6, 0.0)\n"
+        )
+        assert _run(src, "clockdomain") == []
+
+    def test_foreign_pts_minus_wall_fires_outside_clamp(self):
+        src = (
+            "import time\n"
+            "def f(pts):\n"
+            "    return time.time() - pts / 1e6\n"
+        )
+        fs = _run(src, "clockdomain")
+        assert len(fs) == 1 and "foreign-wall" in fs[0].message
+
+    def test_cross_domain_min_fires(self):
+        src = (
+            "import time\n"
+            "def f():\n"
+            "    return min(time.time(), time.monotonic())\n"
+        )
+        fs = _run(src, "clockdomain")
+        assert len(fs) == 1 and "min()" in fs[0].message
+
+    def test_clock_helpers_carry_their_domain(self):
+        # the utils.clock naming convention seeds even without resolving
+        # the import — and the real helpers must stay typed
+        src = (
+            "from parameter_server_tpu.utils.clock import (\n"
+            "    now_mono_s, now_wall_s)\n"
+            "def f():\n"
+            "    return now_wall_s() - now_mono_s()\n"
+        )
+        fs = _run(src, "clockdomain")
+        assert len(fs) == 1 and "subtraction" in fs[0].message
+
+
+class TestIdtypeChecker:
+    def test_cross_space_comparison_fires(self):
+        src = "def f(cid, rank):\n    return cid == rank\n"
+        fs = _run(src, "idtype")
+        assert len(fs) == 1 and "cross-identity comparison" in fs[0].message
+
+    def test_same_space_comparison_is_clean(self):
+        src = "def f(cid, peer_cid):\n    return cid == peer_cid\n"
+        assert _run(src, "idtype") == []
+
+    def test_arithmetic_on_opaque_ver_fires(self):
+        src = "def f(ver):\n    return ver + 1\n"
+        fs = _run(src, "idtype")
+        assert len(fs) == 1 and "EQUALITY-ONLY" in fs[0].message
+
+    def test_seq_and_rank_stay_numeric(self):
+        src = (
+            "def f(seq, rank):\n"
+            "    return seq + 1 + rank\n"
+        )
+        assert _run(src, "idtype") == []
+
+    def test_ver_ordering_comparison_fires(self):
+        src = "def f(ver, prev_ver):\n    return ver < prev_ver\n"
+        fs = _run(src, "idtype")
+        assert len(fs) == 1 and "equality-only" in fs[0].message
+
+    def test_ver_equality_is_clean(self):
+        src = "def f(ver, prev_ver):\n    return ver == prev_ver\n"
+        assert _run(src, "idtype") == []
+
+    def test_swapped_positional_ids_fire_at_call_boundary(self):
+        # the named acceptance drill: (rank, cid) passed as (cid, rank)
+        src = (
+            "def route(rank, cid):\n"
+            "    pass\n"
+            "def f(cid, rank):\n"
+            "    route(cid, rank)\n"
+        )
+        fs = _run(src, "idtype")
+        assert len(fs) == 2
+        assert all("call boundary" in f.message for f in fs)
+
+    def test_correct_positional_ids_are_clean(self):
+        src = (
+            "def route(rank, cid):\n"
+            "    pass\n"
+            "def f(cid, rank):\n"
+            "    route(rank, cid)\n"
+        )
+        assert _run(src, "idtype") == []
+
+    def test_swapped_keyword_id_fires(self):
+        src = (
+            "def route(rank, cid):\n"
+            "    pass\n"
+            "def f(cid):\n"
+            "    route(rank=cid, cid=0)\n"
+        )
+        fs = _run(src, "idtype")
+        assert len(fs) == 1 and "keyword argument" in fs[0].message
+
+    def test_bit_packing_of_ids_is_structure_not_arithmetic(self):
+        # encode/decode by nature: header flag words and the
+        # ver<<shift|nonce life stamp must not fire
+        src = (
+            "_BF_CID = 1\n"
+            "NONCE_SHIFT = 40\n"
+            "def enc(flags, cid_present):\n"
+            "    if cid_present:\n"
+            "        flags |= _BF_CID\n"
+            "    return flags & _BF_CID\n"
+            "def life(ver):\n"
+            "    return ver >> NONCE_SHIFT\n"
+        )
+        assert _run(src, "idtype") == []
+
+    def test_all_caps_constants_never_seed_id_spaces(self):
+        from parameter_server_tpu.analysis.quantity import id_of_name
+
+        assert id_of_name("_BF_CID") is None
+        assert id_of_name("NONCE_SHIFT") is None
+        assert id_of_name("peer_cid") == "cid"
+        assert id_of_name("trace_id") == "trace"
+        assert id_of_name("worker") == "rank"
+
+
+class TestSharedFixpoint:
+    def test_all_flow_checkers_share_one_dataflow_run(self, monkeypatch):
+        # the ISSUE 20 perf tentpole: rcu + wireproto + the quantity
+        # triple ride ONE DataflowAnalysis fixpoint per package index
+        # (analysis/flowrun.py), not one per checker
+        from parameter_server_tpu.analysis import dataflow
+
+        calls: list[int] = []
+        orig = dataflow.DataflowAnalysis.run
+
+        def counting(self):
+            calls.append(1)
+            return orig(self)
+
+        monkeypatch.setattr(dataflow.DataflowAnalysis, "run", counting)
+        src = (
+            "import time\n"
+            "class S:\n"  # a real RCU publisher: the rcu policy engages
+            "    def __init__(self):\n"
+            "        self._pub = ({}, 1)\n"
+            "    @property\n"
+            "    def state(self):\n"
+            "        return self._pub[0]\n"
+            "    @state.setter\n"
+            "    def state(self, new):\n"
+            "        self._pub = (new, self._pub[1] + 1)\n"
+            "def f(lat_ms, svc_us, cid, rank):\n"
+            "    t0 = time.monotonic()\n"
+            "    lat_ms + svc_us\n"
+            "    cid == rank\n"
+            "    return time.time() - t0\n"
+        )
+        fs = analyze_sources({"s.py": src})
+        assert len(calls) == 1, f"{len(calls)} fixpoints for one index"
+        # and the one walk still feeds every policy its findings
+        assert {f.checker for f in fs} == {"units", "clockdomain", "idtype"}
+
+
+class TestChangedOnly:
+    _VIOLATION = (
+        "import threading\nimport time\n"
+        "_lk = threading.Lock()\n"
+        "def m():\n"
+        "    with _lk:\n"
+        "        time.sleep(1)\n"
+    )
+
+    def _main(self, argv):
+        from parameter_server_tpu.analysis.__main__ import main
+
+        return main(argv)
+
+    def _git_pkg(self, tmp_path):
+        import subprocess
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "old.py").write_text(self._VIOLATION)
+
+        def git(*args):
+            subprocess.run(
+                ["git", "-C", str(tmp_path), "-c",
+                 "user.email=t@t", "-c", "user.name=t", *args],
+                check=True, capture_output=True,
+            )
+
+        git("init", "-q")
+        git("add", "-A")
+        git("commit", "-qm", "seed")
+        return pkg
+
+    def test_report_narrows_to_changed_files(self, tmp_path, capsys):
+        pkg = self._git_pkg(tmp_path)
+        (pkg / "new.py").write_text(self._VIOLATION)  # untracked
+        rc = self._main(
+            ["--root", str(pkg), "--changed-only", "HEAD"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1  # the changed file's finding still gates
+        # file anchors, not raw substrings: a finding's MESSAGE may
+        # legitimately mention the unchanged file (e.g. the lock's
+        # defining module)
+        assert "new.py:" in out and not out.startswith("old.py:")
+        assert "old.py:5:" not in out and "old.py:6:" not in out
+        assert "changed-only" in out
+
+    def test_clean_changed_set_exits_zero_despite_old_debt(self, tmp_path):
+        pkg = self._git_pkg(tmp_path)
+        (pkg / "new.py").write_text("x = 1\n")
+        rc = self._main(
+            ["--root", str(pkg), "--changed-only", "HEAD"]
+        )
+        assert rc == 0  # old.py's finding exists but is out of scope
+
+    def test_fails_open_without_git(self, tmp_path, capsys):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "old.py").write_text(self._VIOLATION)
+        rc = self._main(
+            ["--root", str(pkg), "--changed-only", "HEAD"]
+        )
+        err = capsys.readouterr()
+        assert rc == 1  # everything reports when git can't answer
+        assert "old.py" in err.out
+        assert "reporting ALL findings" in err.err
+
+    def test_update_baseline_refuses_changed_only(self, tmp_path, capsys):
+        with pytest.raises(SystemExit):
+            self._main(
+                ["--root", str(tmp_path), "--baseline", "b.json",
+                 "--update-baseline", "--changed-only", "HEAD"]
+            )
 
 
 class TestStalePragma:
